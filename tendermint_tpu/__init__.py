@@ -1,0 +1,27 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A from-scratch rebuild of the capability surface of Tendermint Core v0.16.0
+(reference: /root/reference, pure Go), designed TPU-first:
+
+- The crypto/hash plane (the reference's scalar hot loops:
+  types/validator_set.go:240-265 commit verification, types/vote_set.go:189
+  vote ingestion, types/tx.go:33-46 Merkle trees) is re-architected as
+  *batched* JAX/XLA kernels: vmapped Ed25519 verification over int32 limb
+  field arithmetic and a vmapped SHA-256 Merkle tree, sharded over a TPU
+  mesh with shard_map for multi-chip scale.
+- The consensus/p2p/storage runtime around it is an asyncio host program
+  mirroring the reference's reactor architecture (p2p/switch.go,
+  consensus/reactor.go) without copying it.
+
+Package layout:
+  ops/       pure JAX kernels: field arithmetic, Ed25519, SHA-256, Merkle
+  models/    composed pipelines: BatchVerifier, commit/header certification
+  parallel/  mesh + sharding for multi-chip batch verification
+  utils/     host-side helpers, pure-Python reference crypto
+  types/     data model: Block, Vote, VoteSet, ValidatorSet, ...
+  statemod/  replicated state + block execution
+  consensus/ BFT state machine, WAL, replay
+  mempool/ evidencepool/ blockchain/ p2p/ rpc/ lite/ node/ cli/ abci/
+"""
+
+__version__ = "0.1.0"

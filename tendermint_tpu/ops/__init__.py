@@ -1,0 +1,14 @@
+"""Pure JAX compute kernels: the TPU-native crypto/hash plane.
+
+These modules replace the reference's scalar pure-Go crypto dependencies
+(go-crypto Ed25519, tmlibs/merkle — see SURVEY.md §2.9) with batched,
+jit/vmap/shard_map-friendly kernels:
+
+  field.py    GF(2^255-19) arithmetic on int32 limb vectors
+  curve.py    Edwards25519 point ops (extended coords, complete addition)
+  ed25519.py  batched signature verification (the hot kernel)
+  sha256.py   SHA-256 compression on uint32 words, batched
+  merkle.py   batched binary Merkle trees (root / proofs / verify)
+
+(modules listed before they land are part of the build plan, SURVEY.md §7)
+"""

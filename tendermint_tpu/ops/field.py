@@ -1,0 +1,274 @@
+"""GF(2^255-19) arithmetic on int32 limb vectors — the base of the Ed25519 kernel.
+
+TPU-first design notes
+----------------------
+TPUs have no native 64-bit integer path, so the usual 51-bit-limb (u64) or
+25.5-bit-limb (u32 with u64 accumulate) representations used by CPU
+implementations do not map. Instead a field element is 20 limbs of 13 bits
+stored in int32, little-endian: value = sum(limb[i] * 2**(13*i)).
+
+Why 13 bits: schoolbook products limb_i*limb_j <= (2^13-1)^2 < 2^26, and a
+product column accumulates at most 20 of them, so every intermediate stays
+below 20 * 2^26 < 2^31 — exact in int32, which the TPU VPU handles natively.
+All ops are shape-polymorphic over leading batch dims: a field element is an
+int32[..., 20] array, so vmap/jit/shard_map compose trivially and XLA
+vectorizes the limb arithmetic across the batch.
+
+This replaces the scalar field arithmetic hidden inside the reference's
+go-crypto dependency (used at types/vote.go:114, types/validator_set.go:257
+of the reference) with a batched equivalent.
+
+Reduction: 2^260 = 2^5 * 2^255 ≡ 2^5 * 19 = 608 (mod p), so limb 20+j folds
+into limb j with weight 608. Elements are kept "normalized" (all limbs in
+[0, 2^13)) between ops; full canonical reduction below p happens only at
+encode/compare time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 13
+NLIMBS = 20
+MASK = (1 << LIMB_BITS) - 1  # 8191
+# 2^(13*20) = 2^260 ≡ 608 (mod p)
+FOLD = 608
+
+P = (1 << 255) - 19
+# d = -121665/121666 mod p  (edwards25519 curve constant)
+D_INT = pow(121666, P - 2, P) * (P - 121665) % P
+D2_INT = (2 * D_INT) % P
+# sqrt(-1) = 2^((p-1)/4)
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+
+
+def to_limbs_raw(x: int) -> np.ndarray:
+    """Python int in [0, 2^260) -> int32[20] limbs, WITHOUT mod-p reduction."""
+    assert 0 <= x < 1 << (LIMB_BITS * NLIMBS)
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    return out
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int -> int32[20] limb array, reduced mod p (host-side helper)."""
+    return to_limbs_raw(x % P)
+
+
+def from_limbs(limbs) -> int:
+    """int32[20] limb array (single element, no batch dims) -> Python int (no mod)."""
+    arr = np.asarray(limbs)
+    val = 0
+    for i in reversed(range(arr.shape[-1])):
+        val = (val << LIMB_BITS) + int(arr[..., i])
+    return val
+
+
+def batch_to_limbs(xs) -> np.ndarray:
+    """List of ints -> int32[N, 20]."""
+    return np.stack([to_limbs(x) for x in xs])
+
+
+# Constant limb arrays (host numpy; become jnp constants when traced).
+ZERO = to_limbs(0)
+ONE = to_limbs(1)
+D = to_limbs(D_INT)
+D2 = to_limbs(D2_INT)
+SQRT_M1 = to_limbs(SQRT_M1_INT)
+P_LIMBS = to_limbs_raw(P)  # raw: to_limbs would reduce p to 0
+
+# A representation of 0 (mod p) whose every limb exceeds 2^13-1, used to keep
+# subtraction non-negative: all limbs 2^14-2 sums to 2^261-2 ≡ 1214 (mod p),
+# so lowering limb 0 by 1214 gives an exact multiple of p.
+_SUB_BIAS = np.full(NLIMBS, (1 << (LIMB_BITS + 1)) - 2, dtype=np.int32)
+_SUB_BIAS[0] -= 1214
+assert (sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(_SUB_BIAS))) % P == 0
+
+
+def _normalize(cols):
+    """Carry-propagate a list of >=20 int32 columns (each < 2^31, >= 0) into
+    20 normalized limbs. Columns beyond 19 (and the final carry) fold back
+    with weight 608 per 2^260. Three carry passes provably suffice for any
+    input bounded by the schoolbook-product worst case (see module docstring).
+    """
+    cols = list(cols)
+    for _ in range(3):
+        carry = None
+        out = []
+        for k in range(len(cols)):
+            t = cols[k] if carry is None else cols[k] + carry
+            out.append(t & MASK)
+            carry = t >> LIMB_BITS
+        # fold high limbs (positions >= 20) plus the outgoing carry
+        high = out[NLIMBS:] + [carry]
+        res = out[:NLIMBS]
+        for j, h in enumerate(high):
+            res[j] = res[j] + h * FOLD
+        cols = res
+    return jnp.stack(cols, axis=-1)
+
+
+def add(a, b):
+    """Field add: int32[...,20] x int32[...,20] -> normalized int32[...,20]."""
+    cols = [a[..., k] + b[..., k] for k in range(NLIMBS)]
+    return _normalize(cols)
+
+
+def sub(a, b):
+    """Field subtract, kept non-negative via a limb-wise bias ≡ 0 (mod p)."""
+    bias = jnp.asarray(_SUB_BIAS)
+    cols = [a[..., k] + bias[k] - b[..., k] for k in range(NLIMBS)]
+    return _normalize(cols)
+
+
+def neg(a):
+    return sub(jnp.broadcast_to(jnp.asarray(ZERO), a.shape), a)
+
+
+def mul(a, b):
+    """Field multiply via shifted-row schoolbook accumulation.
+
+    Row i contributes a[i] * b at column offset i; every partial column stays
+    < 20 * 2^26 < 2^31 so the whole product is exact in int32.
+    """
+    batch_shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    wide = jnp.zeros(batch_shape + (2 * NLIMBS - 1,), dtype=jnp.int32)
+    for i in range(NLIMBS):
+        row = a[..., i : i + 1] * b
+        wide = wide.at[..., i : i + NLIMBS].add(row)
+    return _normalize([wide[..., k] for k in range(2 * NLIMBS - 1)])
+
+
+def square(a):
+    return mul(a, a)
+
+
+def mul_small(a, c: int):
+    """Multiply by a small non-negative Python int (< 2^17)."""
+    cols = [a[..., k] * c for k in range(NLIMBS)]
+    return _normalize(cols)
+
+
+def select(cond, a, b):
+    """cond ? a : b, with cond broadcast over the limb axis."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def pow_const(x, exp: int):
+    """x ** exp for a static Python-int exponent, via left-to-right
+    square-and-multiply driven by lax.fori_loop (small trace, runtime loop)."""
+    bits = np.array([(exp >> i) & 1 for i in reversed(range(exp.bit_length()))],
+                    dtype=np.int32)
+    bits_arr = jnp.asarray(bits)
+    one = jnp.broadcast_to(jnp.asarray(ONE), x.shape)
+
+    def body(i, acc):
+        acc = mul(acc, acc)
+        acc_mul = mul(acc, x)
+        return select(jnp.broadcast_to(bits_arr[i] == 1, acc.shape[:-1]), acc_mul, acc)
+
+    return jax.lax.fori_loop(0, len(bits), body, one)
+
+
+def inv(x):
+    """Multiplicative inverse x^(p-2). inv(0) = 0 (used intentionally by
+    point encoding of the identity)."""
+    return pow_const(x, P - 2)
+
+
+def canonical(x):
+    """Fully reduce a normalized element below p (for encode/compare)."""
+    # Fold bits >= 255: bit 255 lives at bit 8 of limb 19 (13*19 = 247).
+    cols = [x[..., k] for k in range(NLIMBS)]
+    for _ in range(2):
+        hi = cols[NLIMBS - 1] >> 8
+        cols[NLIMBS - 1] = cols[NLIMBS - 1] & 0xFF
+        cols[0] = cols[0] + 19 * hi
+        carry = None
+        out = []
+        for k in range(NLIMBS):
+            t = cols[k] if carry is None else cols[k] + carry
+            out.append(t & MASK)
+            carry = t >> LIMB_BITS
+        cols = out
+        cols[NLIMBS - 1] = cols[NLIMBS - 1] + (carry << LIMB_BITS)  # 0 for normalized input
+    x = jnp.stack(cols, axis=-1)
+    # One conditional subtract of p (value is now < 2^255 + 608 < 2p).
+    p_arr = jnp.asarray(P_LIMBS)
+    borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    outs = []
+    for k in range(NLIMBS):
+        t = x[..., k] - p_arr[k] + borrow
+        outs.append(t & MASK)
+        borrow = t >> LIMB_BITS  # arithmetic shift: 0 or -1
+    sub_p = jnp.stack(outs, axis=-1)
+    ge_p = borrow == 0
+    return select(ge_p, sub_p, x)
+
+
+def is_zero(x):
+    c = canonical(x)
+    return jnp.all(c == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_odd(x):
+    """Parity of the canonical value (used for point-sign handling)."""
+    return (canonical(x)[..., 0] & 1) == 1
+
+
+_BIT_W = np.arange(LIMB_BITS, dtype=np.int32)
+_BYTE_W = np.arange(8, dtype=np.int32)
+
+
+def to_bytes(x):
+    """Canonical little-endian 32-byte encoding: int32[...,20] -> uint8[...,32]."""
+    c = canonical(x)
+    bits = (c[..., :, None] >> jnp.asarray(_BIT_W)) & 1  # (..., 20, 13)
+    bits = bits.reshape(bits.shape[:-2] + (NLIMBS * LIMB_BITS,))[..., :256]
+    by = bits.reshape(bits.shape[:-1] + (32, 8))
+    return jnp.sum(by << jnp.asarray(_BYTE_W), axis=-1).astype(jnp.uint8)
+
+
+def from_bytes(b, mask_high_bit: bool = True):
+    """uint8[...,32] little-endian -> (limbs int32[...,20], high_bit int32[...]).
+
+    high_bit is bit 255 (the sign bit in point encodings). When
+    mask_high_bit, the returned limbs encode only the low 255 bits. The
+    value is NOT reduced mod p (matches the reference's permissive decoding
+    of y-coordinates)."""
+    b = b.astype(jnp.int32)
+    bits = (b[..., :, None] >> jnp.asarray(_BYTE_W)) & 1  # (..., 32, 8)
+    bits = bits.reshape(bits.shape[:-2] + (256,))
+    high = bits[..., 255]
+    if mask_high_bit:
+        bits = bits.at[..., 255].set(0)
+    pad = jnp.zeros(bits.shape[:-1] + (NLIMBS * LIMB_BITS - 256,), dtype=jnp.int32)
+    bits = jnp.concatenate([bits, pad], axis=-1)
+    limbs = bits.reshape(bits.shape[:-1] + (NLIMBS, LIMB_BITS))
+    return jnp.sum(limbs << jnp.asarray(_BIT_W), axis=-1), high
+
+
+def sqrt_ratio(u, v):
+    """Compute x with x^2 * v == u, flagging non-squares.
+
+    Returns (x, ok) where ok is False when u/v is not a QR. Uses the
+    standard exponent trick: r = u * v^3 * (u * v^7)^((p-5)/8), then fix up
+    by sqrt(-1) when v * r^2 == -u.
+    """
+    v3 = mul(square(v), v)
+    v7 = mul(square(v3), v)
+    r = mul(mul(u, v3), pow_const(mul(u, v7), (P - 5) // 8))
+    check = mul(v, square(r))
+    ok_direct = eq(check, u)
+    neg_u = neg(u)
+    ok_flipped = eq(check, neg_u)
+    r = select(ok_flipped, mul(r, jnp.asarray(SQRT_M1)), r)
+    return r, ok_direct | ok_flipped

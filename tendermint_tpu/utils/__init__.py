@@ -1,0 +1,1 @@
+"""Host-side helpers: pure-Python reference crypto, encoding, misc."""

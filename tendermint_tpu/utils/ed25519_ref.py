@@ -1,0 +1,135 @@
+"""Pure-Python Ed25519 (RFC 8032) — host reference implementation.
+
+Used for (a) signing (not a hot path: one signature per vote/proposal, like
+the reference's types/priv_validator.go:92), and (b) differential testing
+of the TPU batch-verify kernel in ops/ed25519.py. Cofactorless verification
+(s*B == R + h*A compared via canonical encodings) to match the behavior of
+the Go x/crypto implementation the reference depends on (SURVEY.md §2.9).
+
+Implemented from the RFC 8032 specification; independent of the reference
+codebase (which contains no crypto code of its own).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = (1 << 255) - 19
+L = (1 << 252) + 27742317777372353535851937790883648493
+D = pow(121666, P - 2, P) * (P - 121665) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# base point
+_BY = 4 * pow(5, P - 2, P) % P
+
+
+def _recover_x(y: int, sign: int):
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x == 0 and sign:
+        return None
+    if x % 2 != sign:
+        x = P - x
+    return x
+
+
+BX = _recover_x(_BY, 0)
+BASE = (BX, _BY, 1, BX * _BY % P)
+IDENT = (0, 1, 1, 0)
+
+
+def point_add(p, q):
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = (Y1 - X1) * (Y2 - X2) % P
+    b = (Y1 + X1) * (Y2 + X2) % P
+    c = 2 * T1 * T2 * D % P
+    d = 2 * Z1 * Z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_mul(s: int, p):
+    q = IDENT
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p)
+        p = point_add(p, p)
+        s >>= 1
+    return q
+
+
+def point_equal(p, q):
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    return (X1 * Z2 - X2 * Z1) % P == 0 and (Y1 * Z2 - Y2 * Z1) % P == 0
+
+
+def point_compress(p) -> bytes:
+    X, Y, Z, _ = p
+    zi = pow(Z, P - 2, P)
+    x, y = X * zi % P, Y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def point_decompress(b: bytes):
+    if len(b) != 32:
+        return None
+    v = int.from_bytes(b, "little")
+    sign = v >> 255
+    y = v & ((1 << 255) - 1)
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def _sha512(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for pt in parts:
+        h.update(pt)
+    return int.from_bytes(h.digest(), "little")
+
+
+def secret_expand(seed: bytes):
+    assert len(seed) == 32
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(seed: bytes) -> bytes:
+    a, _ = secret_expand(seed)
+    return point_compress(point_mul(a, BASE))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    a, prefix = secret_expand(seed)
+    A = point_compress(point_mul(a, BASE))
+    r = _sha512(prefix, msg) % L
+    R = point_compress(point_mul(r, BASE))
+    h = _sha512(R, A, msg) % L
+    s = (r + h * a) % L
+    return R + s.to_bytes(32, "little")
+
+
+def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """Cofactorless verify: encode(s*B - h*A) == sig[:32] and s < L."""
+    if len(sig) != 64 or len(pubkey) != 32:
+        return False
+    A = point_decompress(pubkey)
+    if A is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    h = _sha512(sig[:32], pubkey, msg) % L
+    neg_A = (P - A[0], A[1], A[2], P - A[3])
+    Q = point_add(point_mul(s, BASE), point_mul(h, neg_A))
+    return point_compress(Q) == sig[:32]

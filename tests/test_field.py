@@ -1,0 +1,127 @@
+"""Differential tests: JAX limb field arithmetic vs Python bigints."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import field as fe
+
+P = fe.P
+rng = random.Random(1234)
+
+EDGE = [0, 1, 2, 19, P - 1, P - 2, P + 1 - 1, (1 << 255) - 1, 1 << 254, P // 2]
+
+
+def rand_vals(n):
+    return [rng.randrange(0, P) for _ in range(n)]
+
+
+def as_batch(vals):
+    return jnp.asarray(fe.batch_to_limbs(vals))
+
+
+def check_batch(limbs, expected):
+    got = [fe.from_limbs(np.asarray(limbs)[i]) % P for i in range(len(expected))]
+    want = [e % P for e in expected]
+    assert got == want
+
+
+def test_roundtrip_to_from_limbs():
+    for v in EDGE + rand_vals(20):
+        assert fe.from_limbs(fe.to_limbs(v)) == v % P
+
+
+def test_add_sub_mul():
+    a_vals = EDGE + rand_vals(30)
+    b_vals = rand_vals(len(a_vals))
+    a, b = as_batch(a_vals), as_batch(b_vals)
+    check_batch(fe.add(a, b), [x + y for x, y in zip(a_vals, b_vals)])
+    check_batch(fe.sub(a, b), [x - y for x, y in zip(a_vals, b_vals)])
+    check_batch(fe.mul(a, b), [x * y for x, y in zip(a_vals, b_vals)])
+    check_batch(fe.square(a), [x * x for x in a_vals])
+    check_batch(fe.neg(a), [-x for x in a_vals])
+
+
+def test_mul_small():
+    a_vals = EDGE + rand_vals(10)
+    a = as_batch(a_vals)
+    check_batch(fe.mul_small(a, 121666), [x * 121666 for x in a_vals])
+
+
+def test_repeated_ops_stay_exact():
+    # chains of ops exercise normalization invariants
+    a_vals = rand_vals(8)
+    b_vals = rand_vals(8)
+    a, b = as_batch(a_vals), as_batch(b_vals)
+    x = fe.mul(fe.add(a, b), fe.sub(a, b))
+    expected = [(av + bv) * (av - bv) for av, bv in zip(a_vals, b_vals)]
+    check_batch(x, expected)
+    y = fe.mul(x, x)
+    check_batch(y, [e * e for e in expected])
+
+
+def test_inv():
+    vals = [1, 2, P - 1] + rand_vals(10)
+    a = as_batch(vals)
+    check_batch(fe.inv(a), [pow(v, P - 2, P) for v in vals])
+    # inv(0) == 0 by convention
+    z = as_batch([0])
+    assert fe.from_limbs(np.asarray(fe.inv(z))[0]) == 0
+
+
+def raw_limbs(x: int) -> np.ndarray:
+    """Encode WITHOUT reducing mod P (so values >= p actually reach canonical)."""
+    assert 0 <= x < 1 << 260
+    out = np.zeros(fe.NLIMBS, dtype=np.int32)
+    for i in range(fe.NLIMBS):
+        out[i] = x & fe.MASK
+        x >>= fe.LIMB_BITS
+    return out
+
+
+def test_canonical_and_compare():
+    vals = [0, 1, P - 1, P, P + 1, 2 * P - 1, 2 * P, (1 << 255) - 19,
+            (1 << 255) - 1, (1 << 256) - 1, (1 << 260) - 1]
+    a = jnp.asarray(np.stack([raw_limbs(v) for v in vals]))
+    c = np.asarray(fe.canonical(a))
+    for i, v in enumerate(vals):
+        assert fe.from_limbs(c[i]) == v % P, v
+    assert list(np.asarray(fe.is_zero(a))) == [v % P == 0 for v in vals]
+
+
+def test_bytes_roundtrip():
+    vals = EDGE + rand_vals(10)
+    a = as_batch(vals)
+    by = np.asarray(fe.to_bytes(a))
+    for i, v in enumerate(vals):
+        assert int.from_bytes(by[i].tobytes(), "little") == v % P
+    limbs, high = fe.from_bytes(jnp.asarray(by))
+    check_batch(limbs, vals)
+    assert not np.asarray(high).any()
+    # high bit detection
+    raw = bytearray((P - 5).to_bytes(32, "little"))
+    raw[31] |= 0x80
+    limbs2, high2 = fe.from_bytes(jnp.asarray(np.frombuffer(bytes(raw), np.uint8)))
+    assert int(np.asarray(high2)) == 1
+    assert fe.from_limbs(np.asarray(limbs2)) == P - 5
+
+
+def test_sqrt_ratio():
+    # squares have roots; non-squares flagged
+    vals = rand_vals(8)
+    squares = [v * v % P for v in vals]
+    u = as_batch(squares)
+    v = as_batch([1] * len(squares))
+    r, ok = fe.sqrt_ratio(u, v)
+    assert np.asarray(ok).all()
+    r_ints = [fe.from_limbs(np.asarray(r)[i]) for i in range(len(squares))]
+    for ri, sq in zip(r_ints, squares):
+        assert ri * ri % P == sq
+    # a known non-residue: 2 is a non-square mod p (p ≡ 5 mod 8 -> 2 is non-QR)
+    nonsq = 2
+    assert pow(nonsq, (P - 1) // 2, P) == P - 1
+    _, ok2 = fe.sqrt_ratio(as_batch([nonsq]), as_batch([1]))
+    assert not np.asarray(ok2).any()
