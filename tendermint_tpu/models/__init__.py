@@ -1,0 +1,6 @@
+"""Composed compute pipelines built on ops/ kernels.
+
+  verifier.py   BatchVerifier — the pluggable batched signature-verify
+                boundary (replaces go-crypto PubKey.VerifyBytes call sites,
+                SURVEY.md §2.9) with TPU / CPU-jax / pure-python backends.
+"""

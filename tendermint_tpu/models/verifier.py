@@ -1,0 +1,86 @@
+"""BatchVerifier — the framework-wide signature verification boundary.
+
+Reference behavior being replaced (SURVEY.md §2.9, BASELINE.md): every vote
+and commit verification calls PubKey.VerifyBytes one signature at a time
+(types/vote_set.go:189, types/validator_set.go:257). Here, all call sites
+(VoteSet.add_vote, ValidatorSet.verify_commit, fast-sync, lite client)
+funnel into one API:
+
+    verifier.verify(items: list[(pubkey, msg, sig)]) -> bool[N]
+
+Backends:
+  "jax"    — ops/ed25519.py batch kernel; the one TPU chip XLA targets, or
+             CPU XLA when no TPU is present. Chunked to BATCH_CHUNK to stay
+             in VMEM (large monolithic batches fall off a perf cliff).
+  "python" — pure-Python RFC 8032 loop (utils/ed25519_ref.py); the
+             bit-exact oracle, also the fastest choice for N <= ~4 on hosts
+             where jit dispatch overhead dominates.
+  "auto"   — python below a size threshold, jax above (the dual-path split
+             SURVEY.md §7 calls for: scalar for interactive single votes,
+             batch for commits/fast-sync/lite).
+
+A sharded multi-chip kernel (parallel/mesh.py) can be injected via
+`kernel=` for mesh deployments.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Sequence
+
+import numpy as np
+
+BATCH_CHUNK = 256  # VMEM-friendly chunk; see bench sweep
+
+
+class BatchVerifier:
+    def __init__(self, backend: str = "auto", auto_threshold: int = 4,
+                 kernel: Callable | None = None):
+        assert backend in ("auto", "jax", "python")
+        self.backend = backend
+        self.auto_threshold = auto_threshold
+        self.kernel = kernel
+        self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}
+
+    def verify(self, items: Sequence[tuple[bytes, bytes, bytes]]) -> np.ndarray:
+        """items: (pubkey32, message, signature64) triples -> bool[N]."""
+        n = len(items)
+        self.stats["calls"] += 1
+        self.stats["sigs"] += n
+        if n == 0:
+            return np.zeros(0, np.bool_)
+        use_jax = self.backend == "jax" or (
+            self.backend == "auto" and n > self.auto_threshold)
+        if not use_jax:
+            from tendermint_tpu.utils import ed25519_ref as ref
+            return np.array([ref.verify(p, m, s) for p, m, s in items], np.bool_)
+        from tendermint_tpu.ops import ed25519
+        self.stats["jax_sigs"] += n
+        pubkeys = [it[0] for it in items]
+        msgs = [it[1] for it in items]
+        sigs = [it[2] for it in items]
+        out = np.zeros(n, np.bool_)
+        for lo in range(0, n, BATCH_CHUNK):
+            hi = min(lo + BATCH_CHUNK, n)
+            out[lo:hi] = ed25519.verify_batch(
+                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel)
+        return out
+
+    def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+        return bool(self.verify([(pubkey, msg, sig)])[0])
+
+
+_default: BatchVerifier | None = None
+
+
+def default_verifier() -> BatchVerifier:
+    """Process-wide verifier; backend from TM_TPU_VERIFIER (auto|jax|python)."""
+    global _default
+    if _default is None:
+        _default = BatchVerifier(os.environ.get("TM_TPU_VERIFIER", "auto"))
+    return _default
+
+
+def set_default_verifier(v: BatchVerifier) -> None:
+    global _default
+    _default = v

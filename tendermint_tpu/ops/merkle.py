@@ -1,0 +1,159 @@
+"""Batched binary Merkle trees on TPU — replaces tmlibs/merkle.
+
+The reference builds trees recursively one RIPEMD160 call at a time
+(types/tx.go:33-46, types/part_set.go:110). This design is level-batched
+and fixed-shape instead:
+
+Spec (deliberately TPU-first, not wire-compatible with the reference):
+  leaf     = SHA256(0x00 || item_bytes)
+  node     = SHA256(0x01 || left || right)
+  pad leaf = 32 zero bytes (unreachable as a real leaf digest)
+  tree     = leaves padded to the next power of two, perfect binary tree
+  root     = SHA256(0x02 || uint64_le(n_leaves) || tree_root)
+
+Padding to a power of two makes every level a dense [m, 64]-shaped batch
+(one vmapped 2-block SHA-256 per level) with no odd-promote control flow,
+and the size-binding outer hash removes padding ambiguity. Proofs all have
+depth log2(padded_n), verified leaf-up.
+
+Host-side mirrors (hashlib) of every device function keep CPU-only nodes
+and proof verification bit-identical.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops import sha256
+
+EMPTY_DIGEST = b"\x00" * 32  # padding leaf
+
+
+# ---------------------------------------------------------------------------
+# Host (hashlib) spec implementation — the semantic reference
+# ---------------------------------------------------------------------------
+
+def leaf_hash(item: bytes) -> bytes:
+    return hashlib.sha256(b"\x00" + item).digest()
+
+
+def node_hash(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(b"\x01" + left + right).digest()
+
+
+def _final_hash(n: int, tree_root: bytes) -> bytes:
+    return hashlib.sha256(b"\x02" + struct.pack("<Q", n) + tree_root).digest()
+
+
+def _padded_size(n: int) -> int:
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
+def root_host(items: list[bytes]) -> bytes:
+    """Merkle root of raw items, entirely on host."""
+    return root_from_digests_host([leaf_hash(it) for it in items])
+
+
+def root_from_digests_host(digests: list[bytes]) -> bytes:
+    n = len(digests)
+    if n == 0:
+        return _final_hash(0, EMPTY_DIGEST)
+    level = list(digests) + [EMPTY_DIGEST] * (_padded_size(n) - n)
+    while len(level) > 1:
+        level = [node_hash(level[i], level[i + 1]) for i in range(0, len(level), 2)]
+    return _final_hash(n, level[0])
+
+
+def proof_host(items: list[bytes], index: int):
+    """Returns (root, aunts) — aunts leaf-up, each 32 bytes."""
+    n = len(items)
+    assert 0 <= index < n
+    level = [leaf_hash(it) for it in items] + \
+        [EMPTY_DIGEST] * (_padded_size(n) - n)
+    aunts = []
+    idx = index
+    while len(level) > 1:
+        aunts.append(level[idx ^ 1])
+        level = [node_hash(level[i], level[i + 1]) for i in range(0, len(level), 2)]
+        idx //= 2
+    return _final_hash(n, level[0]), aunts
+
+
+def verify_proof_host(root: bytes, total: int, index: int, item: bytes,
+                      aunts: list[bytes]) -> bool:
+    if not (0 <= index < total) or _padded_size(max(total, 1)) != 1 << len(aunts):
+        return False
+    h = leaf_hash(item)
+    idx = index
+    for aunt in aunts:
+        h = node_hash(aunt, h) if idx & 1 else node_hash(h, aunt)
+        idx //= 2
+    return _final_hash(total, h) == root
+
+
+# ---------------------------------------------------------------------------
+# Device (jnp) implementation — batched level-by-level
+# ---------------------------------------------------------------------------
+
+_PREFIX_LEAF = np.array([0x00], dtype=np.uint8)
+_PREFIX_NODE = np.array([0x01], dtype=np.uint8)
+
+
+def leaf_hash_device(items):
+    """uint8[..., N, L] -> uint8[..., N, 32] (static item length L)."""
+    pre = jnp.broadcast_to(jnp.asarray(_PREFIX_LEAF), items.shape[:-1] + (1,))
+    return sha256.hash_fixed(jnp.concatenate([pre, items], axis=-1))
+
+
+def _level_up(digests):
+    """uint8[..., M, 32] -> uint8[..., M//2, 32]: one batched tree level."""
+    m = digests.shape[-2]
+    pairs = digests.reshape(digests.shape[:-2] + (m // 2, 64))
+    pre = jnp.broadcast_to(jnp.asarray(_PREFIX_NODE), pairs.shape[:-1] + (1,))
+    return sha256.hash_fixed(jnp.concatenate([pre, pairs], axis=-1))
+
+
+@functools.partial(jax.jit, static_argnames=("n_leaves",))
+def root_from_digests(digests, n_leaves: int):
+    """Device Merkle root: digests uint8[padded, 32] (already padded to a
+    power of two with zero rows beyond n_leaves) -> uint8[32]."""
+    level = digests
+    while level.shape[-2] > 1:
+        level = _level_up(level)
+    tree_root = level[..., 0, :]
+    header = np.concatenate([
+        np.array([0x02], np.uint8),
+        np.frombuffer(struct.pack("<Q", n_leaves), np.uint8)])
+    hdr = jnp.broadcast_to(jnp.asarray(header), tree_root.shape[:-1] + (9,))
+    return sha256.hash_fixed(jnp.concatenate([hdr, tree_root], axis=-1))
+
+
+def pad_digests(digests: np.ndarray) -> np.ndarray:
+    """Host helper: uint8[N,32] -> uint8[padded,32] zero-padded."""
+    n = digests.shape[0]
+    m = _padded_size(max(n, 1))
+    if m == n:
+        return digests
+    return np.concatenate(
+        [digests, np.zeros((m - n, 32), np.uint8)], axis=0)
+
+
+def root(items: list[bytes]) -> bytes:
+    """Merkle root of variable-length items: host leaf hashing (variable
+    shapes), device tree. The empty tree stays on host."""
+    n = len(items)
+    if n == 0:
+        return _final_hash(0, EMPTY_DIGEST)
+    digests = np.stack(
+        [np.frombuffer(leaf_hash(it), np.uint8) for it in items])
+    out = root_from_digests(jnp.asarray(pad_digests(digests)), n)
+    return np.asarray(out).tobytes()

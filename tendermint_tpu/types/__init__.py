@@ -1,0 +1,26 @@
+"""Data model: the consensus-critical value types.
+
+Mirrors the capability surface of the reference's types/ package
+(SURVEY.md §2.1) — Block/Header/Commit, Vote/VoteSet, ValidatorSet,
+PartSet, PrivValidator, Evidence, ConsensusParams, GenesisDoc, EventBus —
+re-designed rather than ported:
+
+- deterministic encoding is canonical JSON (sorted keys, hex bytes, int
+  nanosecond times) instead of go-wire reflection encoding
+- all hashes are the SHA-256 Merkle spec in ops/merkle.py
+- all signature verification funnels through models/verifier.BatchVerifier
+  (batched on TPU) instead of per-signature scalar calls
+"""
+
+from tendermint_tpu.types.keys import PrivKey, PubKey, address_of
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.vote import Vote, VoteType
+from tendermint_tpu.types.block import Block, BlockID, Commit, Header, PartSetHeader
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.types.priv_validator import PrivValidator, PrivValidatorFile
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, Evidence
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.proposal import Heartbeat, Proposal
+from tendermint_tpu.types.events import EventBus, Query, Subscription

@@ -1,0 +1,270 @@
+"""Block, Header, Commit, BlockID — capability parity with types/block.go.
+
+Hashing: every structural hash is the SHA-256 Merkle spec (ops/merkle.py).
+Header.hash is a Merkle root over the canonical field map (the reference
+does a merkle-map of 13 fields, types/block.go:178-197); Commit.hash and
+Data.hash are Merkle roots over items; Block serialization is canonical
+JSON, split into PartSets for gossip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.ops import merkle
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.vote import Vote, VoteType
+
+
+@dataclass
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and not self.hash
+
+    def to_obj(self):
+        return {"total": self.total, "hash": self.hash.hex()}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["total"], bytes.fromhex(o["hash"]))
+
+    def __eq__(self, other):
+        return isinstance(other, PartSetHeader) and \
+            (self.total, self.hash) == (other.total, other.hash)
+
+
+@dataclass
+class BlockID:
+    hash: bytes = b""
+    parts: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        return not self.hash and self.parts.is_zero()
+
+    def key(self) -> str:
+        return self.hash.hex() + "/" + str(self.parts.total) + "/" + self.parts.hash.hex()
+
+    def short(self) -> str:
+        return self.hash.hex()[:8] if self.hash else "<nil>"
+
+    def to_obj(self):
+        return {"hash": self.hash.hex(), "parts": self.parts.to_obj()}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(bytes.fromhex(o["hash"]), PartSetHeader.from_obj(o["parts"]))
+
+    def __eq__(self, other):
+        return isinstance(other, BlockID) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+
+@dataclass
+class Header:
+    chain_id: str = ""
+    height: int = 0
+    time_ns: int = 0
+    num_txs: int = 0
+    total_txs: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+
+    def to_obj(self):
+        return {
+            "chain_id": self.chain_id, "height": self.height,
+            "time_ns": self.time_ns, "num_txs": self.num_txs,
+            "total_txs": self.total_txs,
+            "last_block_id": self.last_block_id.to_obj(),
+            "last_commit_hash": self.last_commit_hash.hex(),
+            "data_hash": self.data_hash.hex(),
+            "validators_hash": self.validators_hash.hex(),
+            "consensus_hash": self.consensus_hash.hex(),
+            "app_hash": self.app_hash.hex(),
+            "last_results_hash": self.last_results_hash.hex(),
+            "evidence_hash": self.evidence_hash.hex(),
+        }
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(
+            chain_id=o["chain_id"], height=o["height"], time_ns=o["time_ns"],
+            num_txs=o["num_txs"], total_txs=o["total_txs"],
+            last_block_id=BlockID.from_obj(o["last_block_id"]),
+            last_commit_hash=bytes.fromhex(o["last_commit_hash"]),
+            data_hash=bytes.fromhex(o["data_hash"]),
+            validators_hash=bytes.fromhex(o["validators_hash"]),
+            consensus_hash=bytes.fromhex(o["consensus_hash"]),
+            app_hash=bytes.fromhex(o["app_hash"]),
+            last_results_hash=bytes.fromhex(o["last_results_hash"]),
+            evidence_hash=bytes.fromhex(o["evidence_hash"]))
+
+    def hash(self) -> bytes:
+        """Merkle root over sorted (field, value) leaves — the merkle-map of
+        types/block.go:178. Empty validators_hash => zero hash (unfilled)."""
+        if not self.validators_hash:
+            return b""
+        obj = self.to_obj()
+        leaves = [encoding.cdumps({k: obj[k]}) for k in sorted(obj)]
+        return merkle.root_host(leaves)
+
+
+@dataclass
+class Data:
+    txs: List[bytes] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.root_host(list(self.txs))
+
+    def to_obj(self):
+        return {"txs": [t.hex() for t in self.txs]}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls([bytes.fromhex(t) for t in o["txs"]])
+
+
+@dataclass
+class Commit:
+    """+2/3 precommits for a block (types/block.go:239). precommits[i] is
+    None when validator i did not precommit (absent)."""
+    block_id: BlockID = field(default_factory=BlockID)
+    precommits: List[Optional[Vote]] = field(default_factory=list)
+
+    def height(self) -> int:
+        for v in self.precommits:
+            if v is not None:
+                return v.height
+        return 0
+
+    def round(self) -> int:
+        for v in self.precommits:
+            if v is not None:
+                return v.round
+        return 0
+
+    def size(self) -> int:
+        return len(self.precommits)
+
+    def is_commit(self) -> bool:
+        return len(self.precommits) > 0
+
+    def validate_basic(self) -> None:
+        """types/block.go:322 semantics."""
+        if self.block_id.is_zero():
+            raise ValueError("commit cannot be for nil block")
+        if not any(v is not None for v in self.precommits):
+            raise ValueError("no precommits in commit")
+        h, r = self.height(), self.round()
+        for v in self.precommits:
+            if v is None:
+                continue
+            if v.type != VoteType.PRECOMMIT:
+                raise ValueError("commit contains non-precommit vote")
+            if v.height != h or v.round != r:
+                raise ValueError("commit votes differ in height/round")
+
+    def hash(self) -> bytes:
+        leaves = [encoding.cdumps(v.to_obj() if v else None)
+                  for v in self.precommits]
+        return merkle.root_host(leaves)
+
+    def to_obj(self):
+        return {"block_id": self.block_id.to_obj(),
+                "precommits": [v.to_obj() if v else None for v in self.precommits]}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(BlockID.from_obj(o["block_id"]),
+                   [Vote.from_obj(v) if v else None for v in o["precommits"]])
+
+
+@dataclass
+class EvidenceData:
+    evidence: list = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.root_host([encoding.cdumps(e.to_obj()) for e in self.evidence])
+
+    def to_obj(self):
+        from tendermint_tpu.types.evidence import evidence_to_obj
+        return {"evidence": [evidence_to_obj(e) for e in self.evidence]}
+
+    @classmethod
+    def from_obj(cls, o):
+        from tendermint_tpu.types.evidence import evidence_from_obj
+        return cls([evidence_from_obj(e) for e in o["evidence"]])
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data = field(default_factory=Data)
+    evidence: EvidenceData = field(default_factory=EvidenceData)
+    last_commit: Commit = field(default_factory=Commit)
+
+    def fill_header(self) -> None:
+        """Populate derived header hashes (types/block.go:74)."""
+        h = self.header
+        if not h.last_commit_hash:
+            h.last_commit_hash = self.last_commit.hash()
+        if not h.data_hash:
+            h.data_hash = self.data.hash()
+        if not h.evidence_hash:
+            h.evidence_hash = self.evidence.hash()
+
+    def validate_basic(self) -> None:
+        """Self-consistency (types/block.go:51)."""
+        if self.header.height < 1:
+            raise ValueError("invalid block height")
+        if self.header.num_txs != len(self.data.txs):
+            raise ValueError("num_txs mismatch")
+        if self.header.height > 1:
+            self.last_commit.validate_basic()
+        if self.header.last_commit_hash != self.last_commit.hash():
+            raise ValueError("last_commit_hash mismatch")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("data_hash mismatch")
+        if self.header.evidence_hash != self.evidence.hash():
+            raise ValueError("evidence_hash mismatch")
+
+    def hash(self) -> bytes:
+        self.fill_header()
+        return self.header.hash()
+
+    def to_obj(self):
+        return {"header": self.header.to_obj(), "data": self.data.to_obj(),
+                "evidence": self.evidence.to_obj(),
+                "last_commit": self.last_commit.to_obj()}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(Header.from_obj(o["header"]), Data.from_obj(o["data"]),
+                   EvidenceData.from_obj(o["evidence"]),
+                   Commit.from_obj(o["last_commit"]))
+
+    def to_bytes(self) -> bytes:
+        return encoding.cdumps(self.to_obj())
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Block":
+        return cls.from_obj(encoding.cloads(b))
+
+    def make_part_set(self, part_size: int):
+        from tendermint_tpu.types.part_set import PartSet
+        return PartSet.from_data(self.to_bytes(), part_size)
+
+    def block_id(self, part_size: int) -> BlockID:
+        ps = self.make_part_set(part_size)
+        return BlockID(self.hash(), ps.header())

@@ -1,0 +1,47 @@
+"""Canonical deterministic encoding — replaces go-wire + canonical_json.go.
+
+The reference signs canonical JSON (types/canonical_json.go) and persists
+go-wire binary. This rebuild uses ONE deterministic encoding for both:
+canonical JSON — UTF-8, sorted keys, minimal separators, bytes as lowercase
+hex, times as integer UNIX nanoseconds, no floats. Hashes are SHA-256 over
+these bytes. Simple, reflection-free, language-portable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+
+def _canon(obj: Any) -> Any:
+    if isinstance(obj, (bytes, bytearray)):
+        return obj.hex()
+    if isinstance(obj, dict):
+        return {k: _canon(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_canon(v) for v in obj]
+    if isinstance(obj, float):
+        raise TypeError("floats are not deterministic; forbidden in canonical encoding")
+    if hasattr(obj, "to_obj"):
+        return _canon(obj.to_obj())
+    return obj
+
+
+def cdumps(obj: Any) -> bytes:
+    """Canonical JSON bytes of a plain obj tree (dicts/lists/ints/str/bytes/None)."""
+    return json.dumps(_canon(obj), sort_keys=True, separators=(",", ":"),
+                      ensure_ascii=False).encode()
+
+
+def cloads(data: bytes) -> Any:
+    return json.loads(data.decode())
+
+
+def chash(obj: Any) -> bytes:
+    """SHA-256 of the canonical encoding."""
+    return hashlib.sha256(cdumps(obj)).digest()
+
+
+def hex_to_bytes(s: str | None) -> bytes | None:
+    return None if s is None else bytes.fromhex(s)

@@ -1,0 +1,186 @@
+"""EventBus — typed pub/sub with a query language.
+
+Capability parity with types/events.go + types/event_bus.go + tmlibs/pubsub:
+every cross-module notification (new block, vote, round step, tx result)
+flows through here, and RPC websocket subscriptions attach with query
+strings like:
+
+    tm.event = 'NewBlock'
+    tm.event = 'Tx' AND tx.hash = 'ABCD'
+    tm.event = 'Tx' AND account.number > 3
+
+Synchronous fan-out (subscribers get events on the publisher's thread into
+queues they drain) — the consensus state machine publishes, asyncio/RPC
+consumers drain. Deliberately simple and deterministic."""
+
+from __future__ import annotations
+
+import queue
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# reserved event types (types/events.go:12-32)
+EventNewBlock = "NewBlock"
+EventNewBlockHeader = "NewBlockHeader"
+EventNewRound = "NewRound"
+EventNewRoundStep = "NewRoundStep"
+EventCompleteProposal = "CompleteProposal"
+EventPolka = "Polka"
+EventUnlock = "Unlock"
+EventRelock = "Relock"
+EventLock = "Lock"
+EventTimeoutPropose = "TimeoutPropose"
+EventTimeoutWait = "TimeoutWait"
+EventVote = "Vote"
+EventProposalHeartbeat = "ProposalHeartbeat"
+EventTx = "Tx"
+EventValidatorSetUpdates = "ValidatorSetUpdates"
+
+# reserved tags (types/event_bus.go:137-146)
+TagEvent = "tm.event"
+TagTxHash = "tx.hash"
+TagTxHeight = "tx.height"
+
+
+_CMP = {
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    ">": lambda a, b: _num(a) is not None and _num(b) is not None and _num(a) > _num(b),
+    "<": lambda a, b: _num(a) is not None and _num(b) is not None and _num(a) < _num(b),
+    ">=": lambda a, b: _num(a) is not None and _num(b) is not None and _num(a) >= _num(b),
+    "<=": lambda a, b: _num(a) is not None and _num(b) is not None and _num(a) <= _num(b),
+    "CONTAINS": lambda a, b: isinstance(a, str) and str(b) in a,
+}
+
+
+def _num(x):
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return None
+
+
+_COND_RE = re.compile(
+    r"\s*([\w.]+)\s*(=|!=|>=|<=|>|<|CONTAINS)\s*"
+    r"(?:'([^']*)'|\"([^\"]*)\"|(\S+))\s*$")
+
+
+class Query:
+    """AND-composed conditions over event tags (tmlibs/pubsub/query)."""
+
+    def __init__(self, s: str):
+        self.source = s.strip()
+        self.conds: List[tuple] = []
+        if self.source:
+            for part in self.source.split(" AND "):
+                m = _COND_RE.match(part)
+                if not m:
+                    raise ValueError(f"bad query condition: {part!r}")
+                key, op = m.group(1), m.group(2)
+                val = next(g for g in m.groups()[2:] if g is not None)
+                self.conds.append((key, op, val))
+
+    def matches(self, tags: Dict[str, Any]) -> bool:
+        for key, op, want in self.conds:
+            have = tags.get(key)
+            if have is None:
+                return False
+            if isinstance(have, (list, tuple, set)):
+                if not any(_CMP[op](str(h), want) for h in have):
+                    return False
+            elif not _CMP[op](str(have), want):
+                return False
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Query) and self.source == other.source
+
+    def __hash__(self):
+        return hash(self.source)
+
+
+@dataclass
+class EventItem:
+    query: str
+    tags: Dict[str, Any]
+    data: Any
+
+
+class Subscription:
+    def __init__(self, query: Query, capacity: int = 1024):
+        self.query = query
+        self.queue: "queue.Queue[EventItem]" = queue.Queue(maxsize=capacity)
+        self.cancelled = False
+
+    def get(self, timeout: Optional[float] = None) -> EventItem:
+        return self.queue.get(timeout=timeout)
+
+    def get_nowait(self) -> Optional[EventItem]:
+        try:
+            return self.queue.get_nowait()
+        except queue.Empty:
+            return None
+
+
+class EventBus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[tuple, Subscription] = {}  # (subscriber, query.source)
+
+    def subscribe(self, subscriber: str, query_str: str,
+                  capacity: int = 1024) -> Subscription:
+        q = Query(query_str)
+        with self._lock:
+            key = (subscriber, q.source)
+            if key in self._subs:
+                raise ValueError(f"already subscribed: {key}")
+            sub = Subscription(q, capacity)
+            self._subs[key] = sub
+            return sub
+
+    def unsubscribe(self, subscriber: str, query_str: str) -> None:
+        with self._lock:
+            key = (subscriber, Query(query_str).source)
+            sub = self._subs.pop(key, None)
+            if sub:
+                sub.cancelled = True
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        with self._lock:
+            for key in [k for k in self._subs if k[0] == subscriber]:
+                self._subs.pop(key).cancelled = True
+
+    def publish(self, event_type: str, data: Any,
+                tags: Optional[Dict[str, Any]] = None) -> None:
+        tags = dict(tags or {})
+        tags[TagEvent] = event_type
+        with self._lock:
+            subs = list(self._subs.values())
+        for sub in subs:
+            if sub.query.matches(tags):
+                try:
+                    sub.queue.put_nowait(EventItem(sub.query.source, tags, data))
+                except queue.Full:
+                    pass  # slow subscriber: drop (reference uses buffered chans)
+
+    # typed helpers (types/event_bus.go)
+
+    def publish_new_block(self, block, block_id) -> None:
+        self.publish(EventNewBlock, {"block": block, "block_id": block_id})
+
+    def publish_new_block_header(self, header) -> None:
+        self.publish(EventNewBlockHeader, {"header": header})
+
+    def publish_vote(self, vote) -> None:
+        self.publish(EventVote, {"vote": vote})
+
+    def publish_tx(self, height: int, index: int, tx: bytes, result: Any,
+                   extra_tags: Optional[Dict[str, Any]] = None) -> None:
+        import hashlib
+        tags = dict(extra_tags or {})
+        tags[TagTxHash] = hashlib.sha256(tx).hexdigest().upper()
+        tags[TagTxHeight] = height
+        self.publish(EventTx, {
+            "height": height, "index": index, "tx": tx, "result": result}, tags)

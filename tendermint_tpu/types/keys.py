@@ -1,0 +1,61 @@
+"""Ed25519 key types. Address = first 20 bytes of SHA-256(pubkey)
+(the reference derives addresses via RIPEMD160, p2p/key.go:43-47; SHA-256
+is this rebuild's single hash primitive)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+from tendermint_tpu.utils import ed25519_ref as _ref
+
+
+def address_of(pubkey: bytes) -> bytes:
+    return hashlib.sha256(pubkey).digest()[:20]
+
+
+@dataclass(frozen=True)
+class PubKey:
+    ed25519: bytes  # 32 bytes
+
+    @property
+    def address(self) -> bytes:
+        return address_of(self.ed25519)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        """Scalar verify — interactive paths only. Hot paths use
+        models/verifier.BatchVerifier."""
+        return _ref.verify(self.ed25519, msg, sig)
+
+    def to_obj(self):
+        return {"type": "ed25519", "value": self.ed25519.hex()}
+
+    @classmethod
+    def from_obj(cls, obj) -> "PubKey":
+        assert obj["type"] == "ed25519"
+        return cls(bytes.fromhex(obj["value"]))
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    seed: bytes  # 32 bytes
+
+    @classmethod
+    def generate(cls, seed: bytes | None = None) -> "PrivKey":
+        return cls(seed if seed is not None else os.urandom(32))
+
+    @property
+    def pubkey(self) -> PubKey:
+        return PubKey(_ref.public_key(self.seed))
+
+    def sign(self, msg: bytes) -> bytes:
+        return _ref.sign(self.seed, msg)
+
+    def to_obj(self):
+        return {"type": "ed25519", "value": self.seed.hex()}
+
+    @classmethod
+    def from_obj(cls, obj) -> "PrivKey":
+        assert obj["type"] == "ed25519"
+        return cls(bytes.fromhex(obj["value"]))

@@ -1,0 +1,90 @@
+"""PartSet — block serialization split into Merkle-proven parts for gossip.
+
+Capability parity with types/part_set.go: NewPartSetFromData (:94),
+AddPart with proof verification (:187-203). Proofs use the ops/merkle.py
+spec; part hashing of the (large, fixed-size) part payloads is the
+device-batched SHA-256 path when building full sets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.ops import merkle
+from tendermint_tpu.types.block import PartSetHeader
+
+
+@dataclass
+class Part:
+    index: int
+    payload: bytes
+    proof: List[bytes]  # aunts, leaf-up
+
+    def to_obj(self):
+        return {"index": self.index, "payload": self.payload.hex(),
+                "proof": [a.hex() for a in self.proof]}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["index"], bytes.fromhex(o["payload"]),
+                   [bytes.fromhex(a) for a in o["proof"]])
+
+
+class PartSet:
+    def __init__(self, total: int, root: bytes):
+        self.total = total
+        self.root = root
+        self.parts: List[Optional[Part]] = [None] * total
+        self.count = 0
+        self._size = 0
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int) -> "PartSet":
+        chunks = [data[i:i + part_size] for i in range(0, len(data), part_size)] or [b""]
+        root = merkle.root_host(chunks)
+        ps = cls(len(chunks), root)
+        for i, c in enumerate(chunks):
+            _, aunts = merkle.proof_host(chunks, i)
+            ps.parts[i] = Part(i, c, aunts)
+        ps.count = len(chunks)
+        ps._size = len(data)
+        return ps
+
+    @classmethod
+    def from_header(cls, header: PartSetHeader) -> "PartSet":
+        return cls(header.total, header.hash)
+
+    def header(self) -> PartSetHeader:
+        return PartSetHeader(self.total, self.root)
+
+    def has_header(self, h: PartSetHeader) -> bool:
+        return self.header() == h
+
+    def add_part(self, part: Part) -> bool:
+        """Verify the part's Merkle proof against the root; reject invalid
+        (types/part_set.go:187-203). Returns False for duplicates."""
+        if part.index >= self.total:
+            raise ValueError("part index out of range")
+        if self.parts[part.index] is not None:
+            return False
+        if not merkle.verify_proof_host(self.root, self.total, part.index,
+                                        part.payload, part.proof):
+            raise ValueError("invalid part proof")
+        self.parts[part.index] = part
+        self.count += 1
+        self._size += len(part.payload)
+        return True
+
+    def get_part(self, index: int) -> Optional[Part]:
+        return self.parts[index]
+
+    def is_complete(self) -> bool:
+        return self.count == self.total
+
+    def get_data(self) -> bytes:
+        assert self.is_complete()
+        return b"".join(p.payload for p in self.parts)
+
+    def bit_array(self) -> List[bool]:
+        return [p is not None for p in self.parts]

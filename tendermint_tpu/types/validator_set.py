@@ -1,0 +1,226 @@
+"""Validator and ValidatorSet — proposer rotation + BATCHED commit verify.
+
+Capability parity with types/validator_set.go, with the central redesign of
+this framework: VerifyCommit (reference :229-273) loops one Ed25519 verify
+per precommit; here all signatures of a commit are collected and dispatched
+to models/verifier.BatchVerifier in ONE call — on TPU that is a single
+fixed-shape kernel launch for the whole validator set (10k validators = one
+batch), the north-star workload of BASELINE.json.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from tendermint_tpu.ops import merkle
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.keys import PubKey, address_of
+from tendermint_tpu.types.vote import VoteType
+
+
+@dataclass
+class Validator:
+    pubkey: bytes                # 32-byte ed25519
+    voting_power: int
+    accum: int = 0               # proposer-priority accumulator
+
+    @property
+    def address(self) -> bytes:
+        return address_of(self.pubkey)
+
+    def copy(self) -> "Validator":
+        return Validator(self.pubkey, self.voting_power, self.accum)
+
+    def compare_accum(self, other: "Validator") -> "Validator":
+        """Higher accum wins; ties break to lower address
+        (types/validator.go:41)."""
+        if self.accum > other.accum:
+            return self
+        if self.accum < other.accum:
+            return other
+        return self if self.address < other.address else other
+
+    def to_obj(self):
+        return {"pubkey": self.pubkey.hex(), "voting_power": self.voting_power,
+                "accum": self.accum}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(bytes.fromhex(o["pubkey"]), o["voting_power"], o["accum"])
+
+
+class ValidatorSet:
+    """Sorted-by-address validator array with accum-based proposer rotation
+    (types/validator_set.go:24-71)."""
+
+    def __init__(self, validators: Sequence[Validator]):
+        self.validators: List[Validator] = sorted(
+            (v.copy() for v in validators), key=lambda v: v.address)
+        addrs = [v.address for v in self.validators]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError("duplicate validator address")
+        self._proposer: Optional[Validator] = None
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet(self.validators)
+        vs._proposer = self._proposer.copy() if self._proposer else None
+        return vs
+
+    def total_voting_power(self) -> int:
+        return sum(v.voting_power for v in self.validators)
+
+    def get_by_address(self, addr: bytes):
+        for i, v in enumerate(self.validators):
+            if v.address == addr:
+                return i, v
+        return -1, None
+
+    def get_by_index(self, i: int) -> Optional[Validator]:
+        return self.validators[i] if 0 <= i < len(self.validators) else None
+
+    def has_address(self, addr: bytes) -> bool:
+        return self.get_by_address(addr)[0] >= 0
+
+    # -- proposer rotation (types/validator_set.go:51-71) ------------------
+
+    def increment_accum(self, times: int = 1) -> None:
+        for _ in range(times):
+            for v in self.validators:
+                v.accum += v.voting_power
+            mostest = self.validators[0]
+            for v in self.validators[1:]:
+                mostest = mostest.compare_accum(v)
+            mostest.accum -= self.total_voting_power()
+            self._proposer = mostest
+
+    def proposer(self) -> Validator:
+        if self._proposer is None:
+            mostest = self.validators[0]
+            for v in self.validators[1:]:
+                mostest = mostest.compare_accum(v)
+            self._proposer = mostest
+        return self._proposer
+
+    # -- hashing ------------------------------------------------------------
+
+    def hash(self) -> bytes:
+        leaves = [encoding.cdumps(
+            {"pubkey": v.pubkey.hex(), "voting_power": v.voting_power})
+            for v in self.validators]
+        return merkle.root_host(leaves)
+
+    def to_obj(self):
+        return {"validators": [v.to_obj() for v in self.validators]}
+
+    @classmethod
+    def from_obj(cls, o):
+        vs = cls([Validator.from_obj(v) for v in o["validators"]])
+        return vs
+
+    # -- commit verification: THE batched hot path --------------------------
+
+    def verify_commit(self, chain_id: str, block_id, height: int, commit,
+                      verifier=None) -> None:
+        """Verify that +2/3 of this set signed the commit.
+
+        Reference semantics (types/validator_set.go:229-273): size match,
+        height match, per-vote sanity, then signature verification and
+        power counting — but the signatures are verified as ONE batch.
+        Raises ValueError on failure.
+        """
+        from tendermint_tpu.models.verifier import default_verifier
+        verifier = verifier or default_verifier()
+        if len(self.validators) != commit.size():
+            raise ValueError(
+                f"commit size {commit.size()} != valset size {len(self.validators)}")
+        if height != commit.height():
+            raise ValueError("commit height mismatch")
+
+        items = []
+        item_power = []
+        round_ = commit.round()
+        for idx, pc in enumerate(commit.precommits):
+            if pc is None:
+                continue
+            if pc.type != VoteType.PRECOMMIT:
+                raise ValueError("commit contains non-precommit")
+            if pc.height != height or pc.round != round_:
+                raise ValueError("commit vote height/round mismatch")
+            val = self.validators[idx]
+            items.append((val.pubkey, pc.sign_bytes(chain_id), pc.signature))
+            item_power.append((val.voting_power, pc.block_id == block_id))
+
+        ok = verifier.verify(items)
+        power_for_block = 0
+        for valid, (power, for_block) in zip(ok, item_power):
+            if not valid:
+                raise ValueError("invalid signature in commit")
+            if for_block:
+                power_for_block += power
+        # (votes for other/nil blocks count toward liveness but not quorum,
+        # matching the reference's treatment of nil precommits in commits)
+        if not power_for_block * 3 > self.total_voting_power() * 2:
+            raise ValueError(
+                f"insufficient voting power: {power_for_block}/{self.total_voting_power()}")
+
+    def verify_commit_any(self, new_set: "ValidatorSet", chain_id: str,
+                          block_id, height: int, commit, verifier=None) -> None:
+        """Lite-client transition check (types/validator_set.go:288): +2/3 of
+        the NEW set signed, and +1/3 of THIS (old, trusted) set signed."""
+        from tendermint_tpu.models.verifier import default_verifier
+        verifier = verifier or default_verifier()
+        if len(new_set.validators) != commit.size():
+            raise ValueError("commit size != new valset size")
+        if height != commit.height():
+            raise ValueError("commit height mismatch")
+
+        items = []
+        meta = []  # (new_power, old_power, for_block)
+        round_ = commit.round()
+        for idx, pc in enumerate(commit.precommits):
+            if pc is None:
+                continue
+            if pc.type != VoteType.PRECOMMIT or pc.height != height or pc.round != round_:
+                raise ValueError("bad commit vote")
+            nv = new_set.validators[idx]
+            oi, ov = self.get_by_address(nv.address)
+            items.append((nv.pubkey, pc.sign_bytes(chain_id), pc.signature))
+            meta.append((nv.voting_power, ov.voting_power if oi >= 0 else 0,
+                         pc.block_id == block_id))
+        ok = verifier.verify(items)
+        new_power = old_power = 0
+        for valid, (npow, opow, for_block) in zip(ok, meta):
+            if not valid:
+                raise ValueError("invalid signature in commit")
+            if for_block:
+                new_power += npow
+                old_power += opow
+        if not new_power * 3 > new_set.total_voting_power() * 2:
+            raise ValueError("insufficient new-set voting power")
+        if not old_power * 3 > self.total_voting_power():
+            raise ValueError("insufficient old-set (trusted) voting power")
+
+    # -- updates -------------------------------------------------------------
+
+    def update_with_changes(self, changes: Sequence[Validator]) -> "ValidatorSet":
+        """Apply ABCI validator updates: power 0 removes, else add/replace
+        (state/execution.go:246 semantics). Returns a new set."""
+        by_addr = {v.address: v.copy() for v in self.validators}
+        for c in changes:
+            if c.voting_power < 0:
+                raise ValueError("negative voting power")
+            if c.voting_power == 0:
+                if c.address not in by_addr:
+                    raise ValueError("removing unknown validator")
+                del by_addr[c.address]
+            else:
+                prev = by_addr.get(c.address)
+                accum = prev.accum if prev else 0
+                by_addr[c.address] = Validator(c.pubkey, c.voting_power, accum)
+        if not by_addr:
+            raise ValueError("validator set would be empty")
+        return ValidatorSet(list(by_addr.values()))

@@ -1,0 +1,97 @@
+"""Vote — a signed prevote/precommit (capability parity: types/vote.go)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.keys import address_of
+
+
+class VoteType:
+    PREVOTE = 1
+    PRECOMMIT = 2
+
+    @staticmethod
+    def valid(t: int) -> bool:
+        return t in (VoteType.PREVOTE, VoteType.PRECOMMIT)
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+@dataclass
+class Vote:
+    validator_address: bytes
+    validator_index: int
+    height: int
+    round: int
+    timestamp_ns: int
+    type: int
+    block_id: "BlockID"          # zero BlockID = nil-vote
+    signature: bytes = b""
+
+    def sign_obj(self, chain_id: str):
+        """Deterministic sign-bytes content (replaces canonical_json.go:58).
+        Excludes validator identity — a vote's meaning is (chain, h, r,
+        type, block, time); identity is bound by the key itself."""
+        return {
+            "@chain_id": chain_id,
+            "@type": "vote",
+            "height": self.height,
+            "round": self.round,
+            "timestamp_ns": self.timestamp_ns,
+            "type": self.type,
+            "block_id": self.block_id.to_obj(),
+        }
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return encoding.cdumps(self.sign_obj(chain_id))
+
+    def to_obj(self):
+        return {
+            "validator_address": self.validator_address.hex(),
+            "validator_index": self.validator_index,
+            "height": self.height,
+            "round": self.round,
+            "timestamp_ns": self.timestamp_ns,
+            "type": self.type,
+            "block_id": self.block_id.to_obj(),
+            "signature": self.signature.hex(),
+        }
+
+    @classmethod
+    def from_obj(cls, o) -> "Vote":
+        from tendermint_tpu.types.block import BlockID
+        return cls(
+            validator_address=bytes.fromhex(o["validator_address"]),
+            validator_index=o["validator_index"],
+            height=o["height"], round=o["round"],
+            timestamp_ns=o["timestamp_ns"], type=o["type"],
+            block_id=BlockID.from_obj(o["block_id"]),
+            signature=bytes.fromhex(o["signature"]))
+
+    def verify(self, chain_id: str, pubkey: bytes) -> bool:
+        """Scalar path (types/vote.go:109). Hot paths batch via VoteSet."""
+        if address_of(pubkey) != self.validator_address:
+            return False
+        from tendermint_tpu.utils import ed25519_ref as ref
+        return ref.verify(pubkey, self.sign_bytes(chain_id), self.signature)
+
+    def validate_basic(self) -> None:
+        if not VoteType.valid(self.type):
+            raise ValueError(f"invalid vote type {self.type}")
+        if self.height < 1 or self.round < 0:
+            raise ValueError("invalid height/round")
+        if len(self.validator_address) != 20:
+            raise ValueError("bad validator address")
+        if self.validator_index < 0:
+            raise ValueError("bad validator index")
+
+    def __str__(self) -> str:
+        t = "prevote" if self.type == VoteType.PREVOTE else "precommit"
+        return (f"Vote{{{self.validator_index}:{self.validator_address.hex()[:8]} "
+                f"{self.height}/{self.round} {t} {self.block_id.short()}}}")

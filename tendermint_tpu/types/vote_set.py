@@ -1,0 +1,186 @@
+"""VoteSet — collects votes for one (height, round, type) and detects +2/3.
+
+Capability parity with types/vote_set.go (the commentary at :15-48 is the
+semantic spec): per-validator single vote with conflict tracking, quorum
+crossing, peer-claimed majorities (SetPeerMaj23), and MakeCommit. Signature
+checking runs through the BatchVerifier; the interactive one-vote path uses
+the scalar backend automatically ("auto" mode), while replay/catch-up can
+feed many votes at once via add_votes_batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tendermint_tpu.types.block import BlockID, Commit
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+
+
+class ConflictingVoteError(Exception):
+    def __init__(self, existing: Vote, new: Vote):
+        super().__init__(f"conflicting vote: {existing} vs {new}")
+        self.existing = existing
+        self.new = new
+
+
+@dataclass
+class _BlockVotes:
+    peer_maj23: bool
+    votes_by_index: Dict[int, Vote] = field(default_factory=dict)
+    power: int = 0
+
+
+class VoteSet:
+    def __init__(self, chain_id: str, height: int, round_: int, type_: int,
+                 valset: ValidatorSet, verifier=None):
+        assert height >= 1 and VoteType.valid(type_)
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.type = type_
+        self.valset = valset
+        self.verifier = verifier
+        # votes[i]: the canonical vote from validator i (first non-conflicting)
+        self.votes: List[Optional[Vote]] = [None] * len(valset)
+        self.power = 0  # total power of all canonical votes
+        self.maj23: Optional[BlockID] = None
+        self.votes_by_block: Dict[str, _BlockVotes] = {}
+        self.peer_maj23s: Dict[str, BlockID] = {}
+
+    # -- adding votes --------------------------------------------------------
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Returns True if added. Raises ConflictingVoteError for a
+        conflicting non-duplicate vote from the same validator (the caller
+        turns that into evidence), ValueError for invalid votes.
+        Validation order mirrors types/vote_set.go:130-216: index/address/
+        step checks, duplicate check, THEN signature."""
+        return self._add_votes([vote])[0]
+
+    def add_votes_batch(self, votes: List[Vote]) -> List[bool]:
+        """Batch ingestion (replay, catch-up, gossip bursts): one
+        BatchVerifier call for all signatures."""
+        return self._add_votes(votes)
+
+    def _add_votes(self, votes: List[Vote]) -> List[bool]:
+        from tendermint_tpu.models.verifier import default_verifier
+        verifier = self.verifier or default_verifier()
+
+        to_verify = []   # (vote, val, pos)
+        results = [False] * len(votes)
+        for pos, vote in enumerate(votes):
+            if vote is None:
+                raise ValueError("nil vote")
+            vote.validate_basic()
+            idx = vote.validator_index
+            if (vote.height, vote.round, vote.type) != (self.height, self.round, self.type):
+                raise ValueError(
+                    f"vote {vote} does not match VoteSet "
+                    f"{self.height}/{self.round}/{self.type}")
+            val = self.valset.get_by_index(idx)
+            if val is None:
+                raise ValueError(f"validator index {idx} out of range")
+            if val.address != vote.validator_address:
+                raise ValueError("vote address does not match validator index")
+            existing = self.votes[idx]
+            if existing is not None:
+                if existing.block_id == vote.block_id:
+                    continue  # duplicate; results[pos] stays False
+                # conflict — still verify the signature before accusing
+            to_verify.append((vote, val, pos))
+
+        ok = verifier.verify([
+            (val.pubkey, v.sign_bytes(self.chain_id), v.signature)
+            for v, val, _ in to_verify])
+        for valid, (vote, val, pos) in zip(ok, to_verify):
+            if not valid:
+                raise ValueError(f"invalid signature on {vote}")
+            results[pos] = self._add_verified(vote, val)
+        return results
+
+    def _add_verified(self, vote: Vote, val) -> bool:
+        """types/vote_set.go:219-287: record by block, track conflicts,
+        detect quorum crossing."""
+        idx = vote.validator_index
+        existing = self.votes[idx]
+        if existing is not None and existing.block_id != vote.block_id:
+            raise ConflictingVoteError(existing, vote)
+
+        key = vote.block_id.key()
+        bv = self.votes_by_block.get(key)
+        if bv is None:
+            bv = _BlockVotes(peer_maj23=key in {b.key() for b in self.peer_maj23s.values()})
+            self.votes_by_block[key] = bv
+        if idx in bv.votes_by_index:
+            return False
+        bv.votes_by_index[idx] = vote
+        bv.power += val.voting_power
+        if existing is None:
+            self.votes[idx] = vote
+            self.power += val.voting_power
+        quorum = self.valset.total_voting_power() * 2 // 3 + 1
+        if bv.power >= quorum and self.maj23 is None:
+            self.maj23 = vote.block_id
+        return True
+
+    # -- peer-claimed majorities --------------------------------------------
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """A peer claims +2/3 for block_id (types/vote_set.go:294)."""
+        prev = self.peer_maj23s.get(peer_id)
+        if prev is not None and prev != block_id:
+            raise ValueError(f"conflicting maj23 claims from peer {peer_id}")
+        self.peer_maj23s[peer_id] = block_id
+        bv = self.votes_by_block.get(block_id.key())
+        if bv is not None:
+            bv.peer_maj23 = True
+
+    # -- queries -------------------------------------------------------------
+
+    def two_thirds_majority(self) -> Optional[BlockID]:
+        return self.maj23
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        return self.power * 3 > self.valset.total_voting_power() * 2
+
+    def has_all(self) -> bool:
+        return self.power == self.valset.total_voting_power()
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        return self.votes[idx]
+
+    def get_by_address(self, addr: bytes) -> Optional[Vote]:
+        i, _ = self.valset.get_by_address(addr)
+        return self.votes[i] if i >= 0 else None
+
+    def bit_array(self) -> List[bool]:
+        return [v is not None for v in self.votes]
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> List[bool]:
+        bv = self.votes_by_block.get(block_id.key())
+        out = [False] * len(self.valset)
+        if bv:
+            for i in bv.votes_by_index:
+                out[i] = True
+        return out
+
+    def make_commit(self) -> Commit:
+        """types/vote_set.go:467: requires an unambiguous +2/3 block."""
+        if self.type != VoteType.PRECOMMIT:
+            raise ValueError("cannot make commit from non-precommit VoteSet")
+        if self.maj23 is None:
+            raise ValueError("no +2/3 majority")
+        precommits = [
+            v if v is not None and v.block_id == self.maj23 else None
+            for v in self.votes]
+        return Commit(block_id=self.maj23, precommits=precommits)
+
+    def __str__(self) -> str:
+        t = "prevote" if self.type == VoteType.PREVOTE else "precommit"
+        frac = f"{self.power}/{self.valset.total_voting_power()}"
+        return f"VoteSet{{H:{self.height} R:{self.round} {t} {frac} maj23:{self.maj23}}}"

@@ -1,0 +1,63 @@
+"""SHA-256 device kernel vs hashlib; Merkle device tree vs host spec."""
+
+import hashlib
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import merkle, sha256
+
+rng = random.Random(7)
+
+
+def test_sha256_fixed_matches_hashlib():
+    for L in (0, 1, 33, 55, 56, 63, 64, 65, 100, 128, 1000):
+        msgs = [rng.randbytes(L) for _ in range(4)]
+        arr = jnp.asarray(np.stack(
+            [np.frombuffer(m, np.uint8).reshape(L) if L else np.zeros(0, np.uint8)
+             for m in msgs]))
+        got = np.asarray(sha256.hash_fixed(arr))
+        for i, m in enumerate(msgs):
+            assert got[i].tobytes() == hashlib.sha256(m).digest(), L
+
+
+def test_root_device_matches_host():
+    for n in (1, 2, 3, 5, 8, 9):  # padded sizes 1,2,4,8,16 — bounded compiles
+        items = [rng.randbytes(rng.randrange(0, 50)) for _ in range(n)]
+        assert merkle.root(items) == merkle.root_host(items), n
+
+
+def test_empty_and_singleton():
+    assert merkle.root([]) == merkle.root_host([])
+    assert merkle.root([b""]) == merkle.root_host([b""])
+    # empty item != empty tree
+    assert merkle.root([b""]) != merkle.root([])
+    # size binding: same digests, different count -> different root
+    assert merkle.root([b"a"]) != merkle.root([b"a", bytes.fromhex("00" * 32)])
+
+
+def test_proofs_roundtrip_and_reject():
+    items = [rng.randbytes(10) for _ in range(11)]
+    root = merkle.root_host(items)
+    for idx in (0, 1, 5, 10):
+        proof_root, aunts = merkle.proof_host(items, idx)
+        assert proof_root == root
+        assert merkle.verify_proof_host(root, len(items), idx, items[idx], aunts)
+        # wrong item
+        assert not merkle.verify_proof_host(root, len(items), idx, b"evil", aunts)
+        # wrong index
+        assert not merkle.verify_proof_host(root, len(items), (idx + 1) % 11,
+                                            items[idx], aunts)
+        # truncated proof
+        assert not merkle.verify_proof_host(root, len(items), idx, items[idx],
+                                            aunts[:-1])
+    # wrong total
+    proof_root, aunts = merkle.proof_host(items, 3)
+    assert not merkle.verify_proof_host(root, 12, 3, items[3], aunts)
+
+
+def test_order_sensitivity():
+    items = [b"a", b"b", b"c"]
+    swapped = [b"b", b"a", b"c"]
+    assert merkle.root_host(items) != merkle.root_host(swapped)

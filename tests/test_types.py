@@ -1,0 +1,324 @@
+"""Data-model semantics tests (modeled on the reference's types/ tests:
+vote_set_test.go quorum/conflicts, validator_set_test.go rotation,
+priv_validator_test.go double-sign protection)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.models.verifier import BatchVerifier
+from tendermint_tpu.types import (
+    Block, BlockID, Commit, ConsensusParams, DuplicateVoteEvidence, GenesisDoc,
+    GenesisValidator, Header, PartSetHeader, PrivKey, PrivValidatorFile,
+    Proposal, Validator, ValidatorSet, Vote, VoteSet)
+from tendermint_tpu.types.block import Data
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.priv_validator import DoubleSignError
+from tendermint_tpu.types.vote import VoteType
+from tendermint_tpu.types.vote_set import ConflictingVoteError
+from tendermint_tpu.types.events import EventBus, Query
+
+CHAIN = "test-chain"
+PYV = BatchVerifier("python")
+
+
+def make_valset(n, power=10):
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [Validator(p.pubkey.ed25519, power) for p in privs]
+    vs = ValidatorSet(vals)
+    # order privs to match sorted validator order
+    by_addr = {p.pubkey.address: p for p in privs}
+    privs_sorted = [by_addr[v.address] for v in vs.validators]
+    return vs, privs_sorted
+
+
+def make_block_id(tag=b"blk"):
+    return BlockID(hash=tag.ljust(32, b"\0"), parts=PartSetHeader(1, b"p" * 32))
+
+
+def signed_vote(priv, idx, height, round_, type_, block_id, ts=1000):
+    v = Vote(validator_address=priv.pubkey.address, validator_index=idx,
+             height=height, round=round_, timestamp_ns=ts, type=type_,
+             block_id=block_id)
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+# ---------------------------------------------------------------- VoteSet --
+
+def test_vote_set_quorum():
+    vs, privs = make_valset(4)
+    bid = make_block_id()
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs, verifier=PYV)
+    for i in range(2):
+        assert vset.add_vote(signed_vote(privs[i], i, 1, 0, VoteType.PREVOTE, bid))
+    assert not vset.has_two_thirds_majority()  # 20/40 power
+    assert vset.add_vote(signed_vote(privs[2], 2, 1, 0, VoteType.PREVOTE, bid))
+    assert vset.has_two_thirds_majority()      # 30/40 > 2/3*40
+    assert vset.two_thirds_majority() == bid
+
+
+def test_vote_set_nil_votes_and_mixed():
+    vs, privs = make_valset(4)
+    bid, nil = make_block_id(), BlockID()
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs, verifier=PYV)
+    vset.add_vote(signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, bid))
+    vset.add_vote(signed_vote(privs[1], 1, 1, 0, VoteType.PREVOTE, nil))
+    vset.add_vote(signed_vote(privs[2], 2, 1, 0, VoteType.PREVOTE, nil))
+    assert vset.has_two_thirds_any()
+    assert not vset.has_two_thirds_majority()
+    vset.add_vote(signed_vote(privs[3], 3, 1, 0, VoteType.PREVOTE, nil))
+    assert vset.two_thirds_majority() == nil  # nil majority
+
+
+def test_vote_set_rejects_bad():
+    vs, privs = make_valset(4)
+    bid = make_block_id()
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs, verifier=PYV)
+    # wrong height
+    with pytest.raises(ValueError):
+        vset.add_vote(signed_vote(privs[0], 0, 2, 0, VoteType.PREVOTE, bid))
+    # forged signature
+    v = signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, bid)
+    v.signature = bytes(64)
+    with pytest.raises(ValueError):
+        vset.add_vote(v)
+    # wrong index/address pairing
+    v2 = signed_vote(privs[1], 0, 1, 0, VoteType.PREVOTE, bid)
+    with pytest.raises(ValueError):
+        vset.add_vote(v2)
+
+
+def test_vote_set_conflicting_votes():
+    vs, privs = make_valset(4)
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs, verifier=PYV)
+    v1 = signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, make_block_id(b"a"))
+    v2 = signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, make_block_id(b"b"))
+    assert vset.add_vote(v1)
+    assert not vset.add_vote(v1)  # duplicate: no-op
+    with pytest.raises(ConflictingVoteError):
+        vset.add_vote(v2)
+
+
+def test_vote_set_make_commit():
+    vs, privs = make_valset(4)
+    bid = make_block_id()
+    vset = VoteSet(CHAIN, 3, 1, VoteType.PRECOMMIT, vs, verifier=PYV)
+    for i in range(3):
+        vset.add_vote(signed_vote(privs[i], i, 3, 1, VoteType.PRECOMMIT, bid))
+    commit = vset.make_commit()
+    commit.validate_basic()
+    assert commit.block_id == bid
+    assert sum(1 for p in commit.precommits if p) == 3
+    # commit verifies against the valset (batched, python backend)
+    vs.verify_commit(CHAIN, bid, 3, commit, verifier=PYV)
+
+
+# ----------------------------------------------------------- ValidatorSet --
+
+def test_verify_commit_batched_jax():
+    """The flagship path: one jax kernel call verifies the whole commit."""
+    vs, privs = make_valset(4)
+    bid = make_block_id()
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PRECOMMIT, vs, verifier=PYV)
+    for i in range(4):
+        vset.add_vote(signed_vote(privs[i], i, 1, 0, VoteType.PRECOMMIT, bid))
+    commit = vset.make_commit()
+    jv = BatchVerifier("jax")
+    vs.verify_commit(CHAIN, bid, 1, commit, verifier=jv)
+    assert jv.stats["jax_sigs"] == 4
+    # tampered signature fails
+    commit.precommits[0].signature = bytes(64)
+    with pytest.raises(ValueError):
+        vs.verify_commit(CHAIN, bid, 1, commit, verifier=BatchVerifier("jax"))
+
+
+def test_verify_commit_insufficient_power():
+    vs, privs = make_valset(4)
+    bid = make_block_id()
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PRECOMMIT, vs, verifier=PYV)
+    for i in range(2):
+        vset.add_vote(signed_vote(privs[i], i, 1, 0, VoteType.PRECOMMIT, bid))
+    commit = Commit(block_id=bid, precommits=[
+        vset.get_by_index(i) for i in range(4)])
+    with pytest.raises(ValueError, match="voting power"):
+        vs.verify_commit(CHAIN, bid, 1, commit, verifier=PYV)
+
+
+def test_proposer_rotation():
+    vs, _ = make_valset(3)
+    vs.validators[0].voting_power = 30  # heavier validator proposes more
+    seen = []
+    for _ in range(10):
+        vs.increment_accum()
+        seen.append(vs.proposer().address)
+    heavy = vs.validators[0].address
+    assert seen.count(heavy) == 6  # 30/(30+10+10) of 10 rounds
+    # determinism
+    vs2, _ = make_valset(3)
+    vs2.validators[0].voting_power = 30
+    seen2 = []
+    for _ in range(10):
+        vs2.increment_accum()
+        seen2.append(vs2.proposer().address)
+    assert seen == seen2
+
+
+def test_valset_updates():
+    vs, privs = make_valset(3)
+    newkey = PrivKey.generate(b"\x77" * 32)
+    vs2 = vs.update_with_changes([Validator(newkey.pubkey.ed25519, 5)])
+    assert len(vs2) == 4 and vs2.total_voting_power() == 35
+    vs3 = vs2.update_with_changes([Validator(newkey.pubkey.ed25519, 0)])
+    assert len(vs3) == 3
+    assert vs.hash() == vs3.hash()  # back to original membership
+    with pytest.raises(ValueError):
+        vs3.update_with_changes([Validator(newkey.pubkey.ed25519, 0)])  # unknown
+
+
+# ---------------------------------------------------------- PrivValidator --
+
+def test_priv_validator_double_sign_protection(tmp_path):
+    path = str(tmp_path / "priv.json")
+    pv = PrivValidatorFile.generate(path, b"\x11" * 32)
+    bid_a, bid_b = make_block_id(b"a"), make_block_id(b"b")
+    va = Vote(pv.address, 0, 5, 0, 111, VoteType.PREVOTE, bid_a)
+    pv.sign_vote(CHAIN, va)
+    # same vote, different timestamp: returns SAME signature
+    va2 = Vote(pv.address, 0, 5, 0, 999, VoteType.PREVOTE, bid_a)
+    pv.sign_vote(CHAIN, va2)
+    assert va2.signature == va.signature
+    # different block at same HRS: refused
+    vb = Vote(pv.address, 0, 5, 0, 111, VoteType.PREVOTE, bid_b)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, vb)
+    # height regression refused, later height fine
+    v_later = Vote(pv.address, 0, 6, 0, 111, VoteType.PREVOTE, bid_b)
+    pv.sign_vote(CHAIN, v_later)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, Vote(pv.address, 0, 4, 0, 1, VoteType.PREVOTE, bid_a))
+    # persistence survives reload
+    pv2 = PrivValidatorFile.load(path)
+    assert (pv2.last_height, pv2.last_step) == (6, 2)
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN, Vote(pv.address, 0, 5, 0, 1, VoteType.PREVOTE, bid_a))
+
+
+# ------------------------------------------------------------------ Block --
+
+def make_commit_for(vs, privs, height, bid):
+    vset = VoteSet(CHAIN, height, 0, VoteType.PRECOMMIT, vs, verifier=PYV)
+    for i, p in enumerate(privs):
+        vset.add_vote(signed_vote(p, i, height, 0, VoteType.PRECOMMIT, bid))
+    return vset.make_commit()
+
+
+def test_block_roundtrip_and_partset():
+    vs, privs = make_valset(4)
+    last_bid = make_block_id(b"prev")
+    commit = make_commit_for(vs, privs, 1, last_bid)
+    block = Block(
+        header=Header(chain_id=CHAIN, height=2, time_ns=123, num_txs=2,
+                      total_txs=5, last_block_id=last_bid,
+                      validators_hash=vs.hash(), consensus_hash=b"c" * 32,
+                      app_hash=b"a" * 32, last_results_hash=b"r" * 32),
+        data=Data(txs=[b"tx1", b"tx2"]),
+        last_commit=commit)
+    block.fill_header()
+    block.validate_basic()
+    h1 = block.hash()
+    # serialization roundtrip preserves hash
+    block2 = Block.from_bytes(block.to_bytes())
+    assert block2.hash() == h1
+    # part set splits and reassembles
+    ps = block.make_part_set(64)
+    assert ps.is_complete()
+    ps2 = PartSet.from_header(ps.header())
+    for i in range(ps.total):
+        assert ps2.add_part(ps.get_part(i))
+    assert ps2.is_complete()
+    assert Block.from_bytes(ps2.get_data()).hash() == h1
+    # corrupt part rejected
+    ps3 = PartSet.from_header(ps.header())
+    bad = Part = ps.get_part(0)
+    import copy
+    bad = copy.deepcopy(ps.get_part(0))
+    bad.payload = b"x" + bad.payload[1:]
+    with pytest.raises(ValueError):
+        ps3.add_part(bad)
+    # tampering with header fields changes the hash
+    block2.header.app_hash = b"z" * 32
+    assert block2.header.hash() != h1
+    # num_txs mismatch caught
+    block.header.num_txs = 3
+    with pytest.raises(ValueError):
+        block.validate_basic()
+
+
+# --------------------------------------------------------------- Evidence --
+
+def test_duplicate_vote_evidence():
+    vs, privs = make_valset(4)
+    p = privs[0]
+    va = signed_vote(p, 0, 1, 0, VoteType.PREVOTE, make_block_id(b"a"))
+    vb = signed_vote(p, 0, 1, 0, VoteType.PREVOTE, make_block_id(b"b"))
+    ev = DuplicateVoteEvidence(p.pubkey.ed25519, va, vb)
+    ev.verify(CHAIN, p.pubkey.ed25519, verifier=PYV)
+    # same block twice is not duplicity
+    ev2 = DuplicateVoteEvidence(p.pubkey.ed25519, va, va)
+    with pytest.raises(ValueError):
+        ev2.verify(CHAIN, p.pubkey.ed25519, verifier=PYV)
+    # forged second vote
+    vb_forged = signed_vote(p, 0, 1, 0, VoteType.PREVOTE, make_block_id(b"c"))
+    vb_forged.signature = bytes(64)
+    ev3 = DuplicateVoteEvidence(p.pubkey.ed25519, va, vb_forged)
+    with pytest.raises(ValueError):
+        ev3.verify(CHAIN, p.pubkey.ed25519, verifier=PYV)
+
+
+# ------------------------------------------------------- Events + queries --
+
+def test_event_query_language():
+    q = Query("tm.event = 'Tx' AND tx.height > 3")
+    assert q.matches({"tm.event": "Tx", "tx.height": 5})
+    assert not q.matches({"tm.event": "Tx", "tx.height": 2})
+    assert not q.matches({"tm.event": "NewBlock", "tx.height": 5})
+    q2 = Query("tx.hash = 'ABCD'")
+    assert q2.matches({"tx.hash": "ABCD", "tm.event": "Tx"})
+    with pytest.raises(ValueError):
+        Query("tm.event ~ 'Tx'")
+
+
+def test_event_bus_pubsub():
+    bus = EventBus()
+    sub = bus.subscribe("test", "tm.event = 'Tx' AND tx.height = 7")
+    bus.publish_tx(7, 0, b"txdata", {"code": 0})
+    bus.publish_tx(8, 0, b"other", {"code": 0})
+    item = sub.get(timeout=1)
+    assert item.data["height"] == 7
+    assert sub.get_nowait() is None  # height-8 event filtered out
+    bus.unsubscribe("test", "tm.event = 'Tx' AND tx.height = 7")
+    bus.publish_tx(7, 1, b"txdata2", {"code": 0})
+    assert sub.get_nowait() is None
+
+
+# ------------------------------------------------------- Params + Genesis --
+
+def test_params_genesis_roundtrip(tmp_path):
+    params = ConsensusParams()
+    params.validate()
+    assert params.hash() == ConsensusParams.from_obj(params.to_obj()).hash()
+    upd = params.update({"block_size": {"max_txs": 5}})
+    assert upd.block_size.max_txs == 5 and params.block_size.max_txs == 100000
+
+    priv = PrivKey.generate(b"\x22" * 32)
+    doc = GenesisDoc(chain_id=CHAIN, validators=[
+        GenesisValidator(priv.pubkey.ed25519, 10, "v0")])
+    doc.validate_and_complete()
+    path = str(tmp_path / "genesis.json")
+    doc.save(path)
+    doc2 = GenesisDoc.load(path)
+    assert doc2.bytes() == doc.bytes()
+    assert doc2.validator_hash() == doc.validator_hash()
