@@ -1,0 +1,9 @@
+"""Replicated-state bookkeeping & block execution (reference state/ pkg).
+
+  state.py       State value-type snapshot        (state/state.go)
+  execution.py   BlockExecutor — the only mutation path (state/execution.go)
+  validation.py  block-vs-state checks incl. batched VerifyCommit
+                 (state/validation.go)
+"""
+
+from tendermint_tpu.state.state import State

@@ -1,0 +1,15 @@
+"""Persistence layer — replaces tmlibs/db (LevelDB) + the reference stores.
+
+  db.py           key-value store abstraction: MemDB + SQLiteDB (stdlib)
+  block_store.py  per-height blocks/parts/commits   (blockchain/store.go)
+  state_store.py  state + historical valsets/params (state/store.go)
+  wal.py          CRC-framed write-ahead log with ENDHEIGHT markers
+                  (consensus/wal.go)
+"""
+
+from tendermint_tpu.storage.db import KVStore, MemDB, SQLiteDB, open_db
+from tendermint_tpu.storage.block_store import BlockMeta, BlockStore
+from tendermint_tpu.storage.state_store import StateStore
+from tendermint_tpu.storage.wal import (
+    WAL, NilWAL, WALMessage, EndHeightMessage, WALCorruptionError,
+)

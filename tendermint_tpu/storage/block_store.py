@@ -1,0 +1,119 @@
+"""BlockStore — per-height persistence of blocks, parts and commits.
+
+Behavior parity with the reference block store (blockchain/store.go:33-268):
+per height it saves a BlockMeta, every Part, the block's LastCommit (under
+H-1) and the SeenCommit; LoadBlock reassembles the block from its parts.
+Keys mirror the reference's `H:`/`P:h:i`/`C:`/`SC:` scheme.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.storage.db import KVStore
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.block import Block, BlockID, Commit, Header
+from tendermint_tpu.types.part_set import Part, PartSet
+
+_HEIGHT_KEY = b"BS:height"
+
+
+def _meta_key(h: int) -> bytes:
+    return b"BS:H:%020d" % h
+
+
+def _part_key(h: int, i: int) -> bytes:
+    return b"BS:P:%020d:%08d" % (h, i)
+
+
+def _commit_key(h: int) -> bytes:
+    return b"BS:C:%020d" % h
+
+
+def _seen_commit_key(h: int) -> bytes:
+    return b"BS:SC:%020d" % h
+
+
+@dataclass
+class BlockMeta:
+    """Summary row for a stored block (blockchain/store.go BlockMeta)."""
+    block_id: BlockID
+    header: Header
+
+    def to_obj(self):
+        return {"block_id": self.block_id.to_obj(),
+                "header": self.header.to_obj()}
+
+    @classmethod
+    def from_obj(cls, o) -> "BlockMeta":
+        return cls(BlockID.from_obj(o["block_id"]),
+                   Header.from_obj(o["header"]))
+
+
+class BlockStore:
+    def __init__(self, db: KVStore):
+        self.db = db
+
+    def height(self) -> int:
+        raw = self.db.get(_HEIGHT_KEY)
+        return 0 if raw is None else int(raw)
+
+    def save_block(self, block: Block, part_set: PartSet,
+                   seen_commit: Commit) -> None:
+        """Persist block at its height (blockchain/store.go:167-200).
+
+        Stores the meta, all parts, block.last_commit under height-1, and
+        the freshly-seen commit under height. Height advances last so a
+        crash mid-save is recovered by overwriting on replay.
+        """
+        h = block.header.height
+        if h != self.height() + 1:
+            raise ValueError(f"save_block: expected height "
+                             f"{self.height() + 1}, got {h}")
+        if not part_set.is_complete():
+            raise ValueError("save_block: part set is not complete")
+        meta = BlockMeta(BlockID(block.hash(), part_set.header()),
+                         block.header)
+        pairs = [(_meta_key(h), encoding.cdumps(meta.to_obj()))]
+        for i in range(part_set.total):
+            part = part_set.get_part(i)
+            pairs.append((_part_key(h, i), encoding.cdumps(part.to_obj())))
+        if block.last_commit is not None:
+            pairs.append((_commit_key(h - 1),
+                          encoding.cdumps(block.last_commit.to_obj())))
+        pairs.append((_seen_commit_key(h),
+                      encoding.cdumps(seen_commit.to_obj())))
+        pairs.append((_HEIGHT_KEY, b"%d" % h))
+        self.db.set_batch(pairs)  # one transaction: atomic + one commit
+
+    def load_block_meta(self, h: int) -> Optional[BlockMeta]:
+        raw = self.db.get(_meta_key(h))
+        return None if raw is None else BlockMeta.from_obj(encoding.cloads(raw))
+
+    def load_block_part(self, h: int, i: int) -> Optional[Part]:
+        raw = self.db.get(_part_key(h, i))
+        return None if raw is None else Part.from_obj(encoding.cloads(raw))
+
+    def load_block(self, h: int) -> Optional[Block]:
+        """Reassemble the block from its parts (blockchain/store.go:70-90)."""
+        meta = self.load_block_meta(h)
+        if meta is None:
+            return None
+        buf = bytearray()
+        for i in range(meta.block_id.parts.total):
+            part = self.load_block_part(h, i)
+            if part is None:
+                raise LookupError(f"block {h} part {i} missing")
+            buf += part.payload
+        return Block.from_bytes(bytes(buf))
+
+    def load_block_commit(self, h: int) -> Optional[Commit]:
+        """The canonical commit for height h (stored with block h+1)."""
+        raw = self.db.get(_commit_key(h))
+        return None if raw is None else Commit.from_obj(encoding.cloads(raw))
+
+    def load_seen_commit(self, h: int) -> Optional[Commit]:
+        """Locally-seen commit for h — may differ in round from canonical."""
+        raw = self.db.get(_seen_commit_key(h))
+        return None if raw is None else Commit.from_obj(encoding.cloads(raw))

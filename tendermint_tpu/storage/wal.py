@@ -1,0 +1,184 @@
+"""Write-ahead log — CRC-framed, ENDHEIGHT-marked (consensus/wal.go).
+
+Every consensus input (peer message, internal message, timeout) is logged
+before it is processed; on restart the tail of the log past the last
+`#ENDHEIGHT` marker is replayed through the state machine (SURVEY.md §3.5).
+
+Frame format (consensus/wal.go:207-222 equivalent):
+    crc32(payload) uint32 BE | len(payload) uint32 BE | payload
+payload = canonical JSON {"time_ns": int, "msg": {"type": str, ...}}.
+A frame whose CRC or length doesn't check raises WALCorruptionError —
+truncated final frames (crash mid-write) are tolerated and cut off.
+
+Files rotate at `rotate_bytes` into numbered backups (wal.1 oldest …), the
+head file is always `wal`; search_for_end_height scans newest→oldest,
+matching the reference's autofile group semantics (consensus/wal.go:152).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from tendermint_tpu.types import encoding
+
+_HEADER = struct.Struct(">II")
+_MAX_FRAME = 2 << 20  # generous: a message is at most one block part + meta
+
+
+class WALCorruptionError(Exception):
+    pass
+
+
+@dataclass
+class WALMessage:
+    """One logged consensus input."""
+    time_ns: int
+    msg: dict  # {"type": ..., ...}; type "endheight" is the marker
+
+    def to_obj(self):
+        return {"time_ns": self.time_ns, "msg": self.msg}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["time_ns"], o["msg"])
+
+
+def EndHeightMessage(height: int) -> dict:
+    """consensus/wal.go:35 — written after height H is committed."""
+    return {"type": "endheight", "height": height}
+
+
+def encode_frame(m: WALMessage) -> bytes:
+    payload = encoding.cdumps(m.to_obj())
+    if len(payload) > _MAX_FRAME:
+        # fail at write time; otherwise the decoder rejects the frame on
+        # restart and the whole WAL becomes unreadable
+        raise ValueError(f"WAL frame {len(payload)}B exceeds {_MAX_FRAME}B")
+    return _HEADER.pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+
+
+def decode_frames(data: bytes, tolerate_truncated_tail: bool = True
+                  ) -> Iterator[WALMessage]:
+    """Decode frames; raises WALCorruptionError on CRC/length mismatch.
+    A truncated final frame (crash mid-write) is dropped silently."""
+    off = 0
+    n = len(data)
+    while off < n:
+        if off + _HEADER.size > n:
+            if tolerate_truncated_tail:
+                return
+            raise WALCorruptionError("truncated frame header")
+        crc, length = _HEADER.unpack_from(data, off)
+        if length > _MAX_FRAME:
+            raise WALCorruptionError(f"frame length {length} too large")
+        start = off + _HEADER.size
+        if start + length > n:
+            if tolerate_truncated_tail:
+                return
+            raise WALCorruptionError("truncated frame payload")
+        payload = data[start:start + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise WALCorruptionError("crc mismatch")
+        try:
+            yield WALMessage.from_obj(encoding.cloads(payload))
+        except Exception as e:  # malformed JSON despite valid CRC
+            raise WALCorruptionError(f"undecodable payload: {e}") from e
+        off = start + length
+
+
+class WAL:
+    def __init__(self, path: str, rotate_bytes: int = 64 << 20,
+                 max_backups: int = 16, light: bool = False):
+        self.path = path
+        self.rotate_bytes = rotate_bytes
+        self.max_backups = max_backups
+        self.light = light  # light mode skips peer messages (wal.go:121-128)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "ab")
+
+    # -- writing -------------------------------------------------------------
+
+    def save(self, msg: dict, time_ns: int = 0) -> None:
+        if self.light and msg.get("peer"):
+            return
+        self._f.write(encode_frame(WALMessage(time_ns, msg)))
+        # write-ahead guarantee: every input reaches the OS before it is
+        # processed (consensus/wal.go flushes after every Save); ENDHEIGHT
+        # additionally fsyncs since it gates replay decisions
+        self._f.flush()
+        if msg.get("type") == "endheight":
+            self.flush()
+        if self._f.tell() >= self.rotate_bytes:
+            self._rotate()
+
+    def save_end_height(self, height: int) -> None:
+        self.save(EndHeightMessage(height))
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.flush()
+        self._f.close()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        for i in range(self.max_backups - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "ab")
+
+    # -- reading -------------------------------------------------------------
+
+    def _files_newest_first(self):
+        files = [self.path]
+        i = 1
+        while os.path.exists(f"{self.path}.{i}"):
+            files.append(f"{self.path}.{i}")
+            i += 1
+        return files
+
+    def messages_after_end_height(self, height: int
+                                  ) -> Optional[list[WALMessage]]:
+        """All messages after `#ENDHEIGHT height`, or None if the marker is
+        absent (consensus/wal.go:152-190: scan newest file backward)."""
+        tail: list[WALMessage] = []
+        for path in self._files_newest_first():
+            with open(path, "rb") as f:
+                msgs = list(decode_frames(f.read()))
+            found_at = None
+            for i in range(len(msgs) - 1, -1, -1):
+                m = msgs[i]
+                if (m.msg.get("type") == "endheight"
+                        and m.msg.get("height") == height):
+                    found_at = i
+                    break
+            if found_at is not None:
+                return msgs[found_at + 1:] + tail
+            tail = msgs + tail
+        return None
+
+    def all_messages(self) -> list[WALMessage]:
+        out: list[WALMessage] = []
+        for path in reversed(self._files_newest_first()):
+            with open(path, "rb") as f:
+                out.extend(decode_frames(f.read()))
+        return out
+
+
+class NilWAL:
+    """No-op WAL (consensus/wal.go:311) for tests/ephemeral nodes."""
+
+    def save(self, msg: dict, time_ns: int = 0) -> None: ...
+    def save_end_height(self, height: int) -> None: ...
+    def flush(self) -> None: ...
+    def close(self) -> None: ...
+    def messages_after_end_height(self, height: int): return None
+    def all_messages(self): return []

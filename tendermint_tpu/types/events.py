@@ -64,23 +64,29 @@ def _num(x):
 
 _COND_RE = re.compile(
     r"\s*([\w.]+)\s*(=|!=|>=|<=|>|<|CONTAINS)\s*"
-    r"(?:'([^']*)'|\"([^\"]*)\"|(\S+))\s*$")
+    r"(?:'([^']*)'|\"([^\"]*)\"|(\S+?))(\s+AND\s+|\s*$)")
 
 
 class Query:
-    """AND-composed conditions over event tags (tmlibs/pubsub/query)."""
+    """AND-composed conditions over event tags (tmlibs/pubsub/query).
+
+    Parsed sequentially condition-by-condition (not split on " AND ") so
+    quoted values may contain " AND " and separators tolerate any amount of
+    whitespace."""
 
     def __init__(self, s: str):
         self.source = s.strip()
         self.conds: List[tuple] = []
-        if self.source:
-            for part in self.source.split(" AND "):
-                m = _COND_RE.match(part)
-                if not m:
-                    raise ValueError(f"bad query condition: {part!r}")
-                key, op = m.group(1), m.group(2)
-                val = next(g for g in m.groups()[2:] if g is not None)
-                self.conds.append((key, op, val))
+        pos = 0
+        while pos < len(self.source):
+            m = _COND_RE.match(self.source, pos)
+            if not m:
+                raise ValueError(
+                    f"bad query condition at {self.source[pos:]!r}")
+            key, op = m.group(1), m.group(2)
+            val = next(g for g in m.groups()[2:5] if g is not None)
+            self.conds.append((key, op, val))
+            pos = m.end()
 
     def matches(self, tags: Dict[str, Any]) -> bool:
         for key, op, want in self.conds:

@@ -86,33 +86,46 @@ class PrivValidator:
         return False
 
     def _sign_at(self, height: int, round_: int, step: int,
-                 sign_bytes: bytes, same_hrs_ok_differs: str) -> bytes:
+                 sign_bytes: bytes, same_hrs_ok_differs: str
+                 ) -> tuple[bytes, Optional[int]]:
+        """Returns (signature, stored_timestamp_ns). stored_timestamp_ns is
+        set when the stored signature is re-used for a message that differs
+        only in timestamp — the caller MUST write that timestamp back into
+        the message so the signature verifies (types/priv_validator.go
+        signVote re-uses both timestamp and signature together)."""
         same = self._check_hrs(height, round_, step)
         if same:
             if sign_bytes == self.last_sign_bytes:
-                return self.last_signature
+                return self.last_signature, None
             if same_hrs_ok_differs == "timestamp" and \
                     _differs_only_in_timestamp(self.last_sign_bytes, sign_bytes):
-                return self.last_signature
+                stored = json.loads(self.last_sign_bytes).get("timestamp_ns")
+                return self.last_signature, stored
             raise DoubleSignError(
                 f"conflicting {same_hrs_ok_differs or 'message'} at "
                 f"{height}/{round_}/{step}")
+        # Sign FIRST: a failed signer must not advance the last-sign state,
+        # or a retry would pair the previous signature with the new message.
+        sig = self.signer.sign(sign_bytes)
         self.last_height, self.last_round, self.last_step = height, round_, step
         self.last_sign_bytes = sign_bytes
-        sig = self.signer.sign(sign_bytes)
         self.last_signature = sig
         self._persist()  # persist BEFORE the signature escapes
-        return sig
+        return sig, None
 
     def sign_vote(self, chain_id: str, vote: Vote) -> None:
         sb = vote.sign_bytes(chain_id)
-        vote.signature = self._sign_at(
+        vote.signature, stored_ts = self._sign_at(
             vote.height, vote.round, vote_step(vote), sb, "timestamp")
+        if stored_ts is not None:
+            vote.timestamp_ns = stored_ts
 
     def sign_proposal(self, chain_id: str, proposal) -> None:
         sb = proposal.sign_bytes(chain_id)
-        proposal.signature = self._sign_at(
+        proposal.signature, stored_ts = self._sign_at(
             proposal.height, proposal.round, _STEP_PROPOSE, sb, "timestamp")
+        if stored_ts is not None:
+            proposal.timestamp_ns = stored_ts
 
     def sign_heartbeat(self, chain_id: str, heartbeat) -> None:
         heartbeat.signature = self.signer.sign(heartbeat.sign_bytes(chain_id))

@@ -59,36 +59,55 @@ class VoteSet:
         step checks, duplicate check, THEN signature."""
         return self._add_votes([vote])[0]
 
-    def add_votes_batch(self, votes: List[Vote]) -> List[bool]:
+    def add_votes_batch(self, votes: List[Vote]
+                        ) -> tuple[List[bool], List[tuple[int, Exception]]]:
         """Batch ingestion (replay, catch-up, gossip bursts): one
-        BatchVerifier call for all signatures."""
-        return self._add_votes(votes)
+        BatchVerifier call for all signatures. One bad vote must not poison
+        the batch: per-vote failures (invalid signature, conflict) are
+        returned as (position, error) pairs while every other vote is still
+        applied — matching the reference's per-vote AddVote error
+        semantics (types/vote_set.go:130)."""
+        errors: List[tuple[int, Exception]] = []
+        results = self._add_votes(votes, errors)
+        return results, errors
 
-    def _add_votes(self, votes: List[Vote]) -> List[bool]:
+    def _add_votes(self, votes: List[Vote],
+                   errors: Optional[List[tuple[int, Exception]]] = None
+                   ) -> List[bool]:
         from tendermint_tpu.models.verifier import default_verifier
         verifier = self.verifier or default_verifier()
+
+        def fail(pos: int, exc: Exception) -> None:
+            if errors is None:
+                raise exc
+            errors.append((pos, exc))
 
         to_verify = []   # (vote, val, pos)
         results = [False] * len(votes)
         for pos, vote in enumerate(votes):
-            if vote is None:
-                raise ValueError("nil vote")
-            vote.validate_basic()
-            idx = vote.validator_index
-            if (vote.height, vote.round, vote.type) != (self.height, self.round, self.type):
-                raise ValueError(
-                    f"vote {vote} does not match VoteSet "
-                    f"{self.height}/{self.round}/{self.type}")
-            val = self.valset.get_by_index(idx)
-            if val is None:
-                raise ValueError(f"validator index {idx} out of range")
-            if val.address != vote.validator_address:
-                raise ValueError("vote address does not match validator index")
+            try:
+                if vote is None:
+                    raise ValueError("nil vote")
+                vote.validate_basic()
+                idx = vote.validator_index
+                if (vote.height, vote.round, vote.type) != \
+                        (self.height, self.round, self.type):
+                    raise ValueError(
+                        f"vote {vote} does not match VoteSet "
+                        f"{self.height}/{self.round}/{self.type}")
+                val = self.valset.get_by_index(idx)
+                if val is None:
+                    raise ValueError(f"validator index {idx} out of range")
+                if val.address != vote.validator_address:
+                    raise ValueError(
+                        "vote address does not match validator index")
+            except Exception as e:
+                fail(pos, e)
+                continue
             existing = self.votes[idx]
-            if existing is not None:
-                if existing.block_id == vote.block_id:
-                    continue  # duplicate; results[pos] stays False
-                # conflict — still verify the signature before accusing
+            if existing is not None and existing.block_id == vote.block_id:
+                continue  # duplicate; results[pos] stays False
+            # (on conflict: still verify the signature before accusing)
             to_verify.append((vote, val, pos))
 
         ok = verifier.verify([
@@ -96,8 +115,12 @@ class VoteSet:
             for v, val, _ in to_verify])
         for valid, (vote, val, pos) in zip(ok, to_verify):
             if not valid:
-                raise ValueError(f"invalid signature on {vote}")
-            results[pos] = self._add_verified(vote, val)
+                fail(pos, ValueError(f"invalid signature on {vote}"))
+                continue
+            try:
+                results[pos] = self._add_verified(vote, val)
+            except ConflictingVoteError as e:
+                fail(pos, e)
         return results
 
     def _add_verified(self, vote: Vote, val) -> bool:

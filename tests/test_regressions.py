@@ -1,0 +1,136 @@
+"""Regression tests for review findings (storage + types hardening)."""
+
+import pytest
+
+from tendermint_tpu.storage.db import MemDB, SQLiteDB, _prefix_upper_bound
+from tendermint_tpu.storage.wal import WAL
+from tendermint_tpu.types import (
+    BlockID, GenesisDoc, GenesisValidator, PrivKey, Validator, ValidatorSet,
+    Vote, VoteSet, VoteType,
+)
+from tendermint_tpu.types.events import Query
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+
+def _vote(key, idx, height=1, round_=0, ts=100, type_=VoteType.PRECOMMIT,
+          block_id=None):
+    return Vote(key.pubkey.address, idx, height, round_, ts, type_,
+                block_id if block_id is not None else BlockID(b"h" * 32))
+
+
+# -- priv validator ----------------------------------------------------------
+
+def test_replayed_vote_reuses_stored_timestamp_and_signature():
+    """A vote regenerated after crash-replay with a newer wall clock must go
+    out with the ORIGINAL timestamp so the reused signature verifies
+    (types/priv_validator.go signVote)."""
+    key = PrivKey.generate(b"\x01" * 32)
+    pv = PrivValidator(LocalSigner(key))
+    v1 = _vote(key, 0, ts=100)
+    pv.sign_vote("chain", v1)
+
+    v2 = _vote(key, 0, ts=999)  # same HRS, only time differs
+    pv.sign_vote("chain", v2)
+    assert v2.timestamp_ns == 100
+    assert v2.signature == v1.signature
+    assert key.pubkey.verify(v2.sign_bytes("chain"), v2.signature)
+
+
+def test_failed_signer_does_not_poison_last_sign_state():
+    """If the signer raises, last-sign state must not advance — a retry must
+    produce a real signature, never the previous height's signature."""
+    key = PrivKey.generate(b"\x02" * 32)
+
+    class FlakySigner(LocalSigner):
+        fail_next = False
+
+        def sign(self, msg):
+            if self.fail_next:
+                self.fail_next = False
+                raise IOError("hsm glitch")
+            return super().sign(msg)
+
+    signer = FlakySigner(key)
+    pv = PrivValidator(signer)
+    v1 = _vote(key, 0, height=1)
+    pv.sign_vote("chain", v1)
+
+    signer.fail_next = True
+    v2 = _vote(key, 0, height=2)
+    with pytest.raises(IOError):
+        pv.sign_vote("chain", v2)
+    # retry must sign the new message, not replay v1's signature
+    v3 = _vote(key, 0, height=2)
+    pv.sign_vote("chain", v3)
+    assert v3.signature != v1.signature
+    assert key.pubkey.verify(v3.sign_bytes("chain"), v3.signature)
+
+
+# -- vote set batch ----------------------------------------------------------
+
+def test_one_bad_signature_does_not_poison_the_batch():
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    valset = ValidatorSet([Validator(k.pubkey.ed25519, 10) for k in keys])
+    vs = VoteSet("chain", 1, 0, VoteType.PRECOMMIT, valset)
+
+    votes = []
+    for i, k in enumerate(keys):
+        _, val = valset.get_by_address(k.pubkey.address)
+        v = _vote(k, valset.get_by_address(k.pubkey.address)[0])
+        v.validator_index = valset.get_by_address(k.pubkey.address)[0]
+        v.signature = k.sign(v.sign_bytes("chain"))
+        votes.append(v)
+    votes[0].signature = b"\x00" * 64  # corrupt first
+
+    results, errors = vs.add_votes_batch(votes)
+    assert results == [False, True, True, True]
+    assert len(errors) == 1 and errors[0][0] == 0
+    assert "signature" in str(errors[0][1])
+
+
+# -- query parsing -----------------------------------------------------------
+
+def test_query_quoted_and_inside_value():
+    q = Query("tm.event = 'Tx' AND tx.memo = 'cats AND dogs'")
+    assert len(q.conds) == 2
+    assert q.matches({"tm.event": "Tx", "tx.memo": "cats AND dogs"})
+    assert not q.matches({"tm.event": "Tx", "tx.memo": "other"})
+
+
+def test_query_variant_whitespace():
+    q = Query("a = 1  AND   b = 2")
+    assert len(q.conds) == 2
+    assert q.matches({"a": 1, "b": 2})
+
+
+# -- db prefix bound ---------------------------------------------------------
+
+def test_prefix_upper_bound_edge_cases(tmp_path):
+    assert _prefix_upper_bound(b"a") == b"b"
+    assert _prefix_upper_bound(b"a\xff") == b"b"
+    assert _prefix_upper_bound(b"\xff\xff") is None
+
+    sq = SQLiteDB(str(tmp_path / "kv.db"))
+    mem = MemDB()
+    keys = [b"x\xff" + b"\xff" * 18, b"x\xff\x01", b"y", b"x\xfe"]
+    for db in (sq, mem):
+        for k in keys:
+            db.set(k, b"v")
+    assert [k for k, _ in sq.iterate(b"x\xff")] == \
+        [k for k, _ in mem.iterate(b"x\xff")] == \
+        sorted([b"x\xff" + b"\xff" * 18, b"x\xff\x01"])
+    sq.close()
+
+
+# -- wal oversize frame ------------------------------------------------------
+
+def test_wal_rejects_oversized_frame_at_write_time(tmp_path):
+    wal = WAL(str(tmp_path / "wal"))
+    with pytest.raises(ValueError, match="exceeds"):
+        wal.save({"type": "part", "data": "ab" * (3 << 20)})
+    # WAL still readable afterwards
+    wal.save({"type": "ok"})
+    wal.close()
+    wal2 = WAL(str(tmp_path / "wal"))
+    assert [m.msg["type"] for m in wal2.all_messages()] == ["ok"]
+    wal2.close()

@@ -1,0 +1,253 @@
+"""Storage layer tests: DB backends, block store, state store, WAL.
+
+Models the reference's store tests (blockchain/store_test.go,
+state/store semantics, consensus/wal_test.go:19-44 incl. corruption).
+"""
+
+import os
+
+import pytest
+
+from tendermint_tpu.state.state import State, make_genesis_state
+from tendermint_tpu.storage import (
+    WAL, BlockStore, MemDB, NilWAL, SQLiteDB, StateStore, WALCorruptionError,
+    open_db,
+)
+from tendermint_tpu.storage.wal import WALMessage, decode_frames, encode_frame
+from tendermint_tpu.types import (
+    Block, BlockID, Commit, GenesisDoc, GenesisValidator, PrivKey,
+    Validator, ValidatorSet, Vote, VoteType,
+)
+from tendermint_tpu.types.block import Data, Header
+from tendermint_tpu.types.params import ConsensusParams
+
+
+def _keys(n, seed=7):
+    return [PrivKey.generate(bytes([seed + i]) * 32) for i in range(n)]
+
+
+def _genesis(keys, chain_id="test-chain"):
+    return GenesisDoc(
+        chain_id=chain_id, genesis_time_ns=1,
+        validators=[GenesisValidator(k.pubkey.ed25519, 10) for k in keys])
+
+
+def _make_block(state: State, height: int, txs, last_commit=None):
+    return state.make_block(height, txs, last_commit or Commit(), time_ns=height)
+
+
+# -- db backends -------------------------------------------------------------
+
+@pytest.mark.parametrize("mk", [lambda tmp: MemDB(),
+                                lambda tmp: SQLiteDB(str(tmp / "kv.db"))])
+def test_kv_roundtrip_and_prefix_iteration(tmp_path, mk):
+    db = mk(tmp_path)
+    db.set(b"a:1", b"v1")
+    db.set(b"a:2", b"v2")
+    db.set(b"b:1", b"v3")
+    assert db.get(b"a:1") == b"v1"
+    assert db.get(b"missing") is None
+    assert [k for k, _ in db.iterate(b"a:")] == [b"a:1", b"a:2"]
+    db.delete(b"a:1")
+    assert db.get(b"a:1") is None
+    db.close()
+
+
+def test_sqlite_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "kv.db")
+    db = SQLiteDB(path)
+    db.set(b"k", b"v")
+    db.close()
+    db2 = SQLiteDB(path)
+    assert db2.get(b"k") == b"v"
+    db2.close()
+
+
+def test_open_db_dispatch(tmp_path):
+    assert isinstance(open_db(None), MemDB)
+    assert isinstance(open_db(":memory:"), MemDB)
+    assert isinstance(open_db(str(tmp_path / "x.db")), SQLiteDB)
+
+
+# -- block store -------------------------------------------------------------
+
+def test_block_store_save_load_roundtrip():
+    keys = _keys(4)
+    state = make_genesis_state(_genesis(keys))
+    bs = BlockStore(MemDB())
+    assert bs.height() == 0
+
+    block = _make_block(state, 1, [b"tx1", b"tx2"])
+    parts = block.make_part_set(64)
+    seen = Commit(block.block_id(64), [])
+    bs.save_block(block, parts, seen)
+
+    assert bs.height() == 1
+    loaded = bs.load_block(1)
+    assert loaded.hash() == block.hash()
+    assert loaded.data.txs == [b"tx1", b"tx2"]
+    meta = bs.load_block_meta(1)
+    assert meta.block_id.hash == block.hash()
+    assert meta.header.height == 1
+    part = bs.load_block_part(1, 0)
+    assert part.index == 0
+    assert bs.load_seen_commit(1).block_id == block.block_id(64)
+    assert bs.load_block(2) is None
+    assert bs.load_block_meta(99) is None
+
+
+def test_block_store_rejects_wrong_height_and_incomplete_parts():
+    keys = _keys(1)
+    state = make_genesis_state(_genesis(keys))
+    bs = BlockStore(MemDB())
+    block = _make_block(state, 2, [])
+    with pytest.raises(ValueError, match="expected height"):
+        bs.save_block(block, block.make_part_set(64), Commit())
+    block1 = _make_block(state, 1, [])
+    from tendermint_tpu.types.part_set import PartSet
+    incomplete = PartSet.from_header(block1.make_part_set(64).header())
+    with pytest.raises(ValueError, match="not complete"):
+        bs.save_block(block1, incomplete, Commit())
+
+
+def test_block_store_last_commit_stored_under_prev_height():
+    keys = _keys(4)
+    state = make_genesis_state(_genesis(keys))
+    bs = BlockStore(MemDB())
+    b1 = _make_block(state, 1, [])
+    bs.save_block(b1, b1.make_part_set(64), Commit(b1.block_id(64), []))
+
+    # block 2 carries commit for height 1
+    pc = [Vote(keys[i].pubkey.address, i, 1, 0, 5, VoteType.PRECOMMIT,
+               b1.block_id(64)) for i in range(4)]
+    commit1 = Commit(b1.block_id(64), pc)
+    state2 = state.copy()
+    state2.last_block_height = 1
+    state2.last_block_id = b1.block_id(64)
+    b2 = state2.make_block(2, [], commit1, time_ns=2)
+    bs.save_block(b2, b2.make_part_set(64), Commit(b2.block_id(64), []))
+
+    got = bs.load_block_commit(1)
+    assert got.height() == 1
+    assert got.block_id == b1.block_id(64)
+
+
+# -- state store -------------------------------------------------------------
+
+def test_state_store_roundtrip_and_genesis():
+    keys = _keys(4)
+    gen = _genesis(keys)
+    ss = StateStore(MemDB())
+    assert ss.load() is None
+    state = ss.load_or_genesis(gen)
+    assert state.chain_id == "test-chain"
+    assert len(state.validators) == 4
+    # reload hits the stored row
+    state2 = ss.load_or_genesis(gen)
+    assert state2.equals(state)
+    # chain-id mismatch is an error
+    with pytest.raises(ValueError, match="chain_id"):
+        ss.load_or_genesis(_genesis(keys, chain_id="other-chain"))
+
+
+def test_state_store_historical_validators_indirection():
+    keys = _keys(4)
+    ss = StateStore(MemDB())
+    state = ss.load_or_genesis(_genesis(keys))  # writes row for height 1
+
+    # heights 1..4: no valset change -> pointer rows
+    for h in range(1, 4):
+        state = state.copy()
+        state.last_block_height = h
+        ss.save(state)
+    vs1 = ss.load_validators(1)
+    vs4 = ss.load_validators(4)
+    assert vs4.hash() == vs1.hash() == state.validators.hash()
+
+    # change at height 5
+    newkeys = _keys(5, seed=40)
+    state = state.copy()
+    state.last_block_height = 4
+    state.validators = ValidatorSet(
+        [Validator(k.pubkey.ed25519, 7) for k in newkeys])
+    state.last_height_validators_changed = 5
+    ss.save(state)
+    assert ss.load_validators(5).hash() == state.validators.hash()
+    assert ss.load_validators(4).hash() == vs1.hash()
+    with pytest.raises(LookupError):
+        ss.load_validators(99)
+
+
+def test_state_store_params_and_abci_responses():
+    keys = _keys(1)
+    ss = StateStore(MemDB())
+    state = ss.load_or_genesis(_genesis(keys))
+    assert ss.load_consensus_params(1).to_obj() == \
+        state.consensus_params.to_obj()
+    ss.save_abci_responses(3, {"deliver_tx": [{"code": 0}], "end_block": {}})
+    assert ss.load_abci_responses(3)["deliver_tx"][0]["code"] == 0
+    assert ss.load_abci_responses(4) is None
+
+
+# -- WAL ---------------------------------------------------------------------
+
+def test_wal_roundtrip_and_endheight_search(tmp_path):
+    wal = WAL(str(tmp_path / "wal"))
+    wal.save({"type": "vote", "h": 1}, time_ns=10)
+    wal.save_end_height(1)
+    wal.save({"type": "proposal", "h": 2}, time_ns=20)
+    wal.save({"type": "vote", "h": 2}, time_ns=21)
+    wal.close()
+
+    wal2 = WAL(str(tmp_path / "wal"))
+    tail = wal2.messages_after_end_height(1)
+    assert [m.msg["type"] for m in tail] == ["proposal", "vote"]
+    assert wal2.messages_after_end_height(7) is None
+    assert len(wal2.all_messages()) == 4
+    wal2.close()
+
+
+def test_wal_truncated_tail_is_tolerated(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.save({"type": "a"})
+    wal.save({"type": "b"})
+    wal.close()
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:  # crash mid-write of the last frame
+        f.write(data[:-3])
+    wal2 = WAL(path)
+    msgs = wal2.all_messages()
+    assert [m.msg["type"] for m in msgs] == ["a"]
+    wal2.close()
+
+
+def test_wal_corruption_detected():
+    frame = bytearray(encode_frame(WALMessage(0, {"type": "x"})))
+    frame[-1] ^= 0xFF  # flip a payload byte -> CRC mismatch
+    with pytest.raises(WALCorruptionError, match="crc"):
+        list(decode_frames(bytes(frame)))
+
+
+def test_wal_rotation_spans_endheight_search(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path, rotate_bytes=256)
+    for h in range(1, 8):
+        wal.save({"type": "vote", "h": h, "pad": "x" * 64})
+        wal.save_end_height(h)
+    wal.close()
+    assert os.path.exists(path + ".1")  # rotation happened
+    wal2 = WAL(path, rotate_bytes=256)
+    tail = wal2.messages_after_end_height(3)
+    assert tail[0].msg == {"type": "vote", "h": 4, "pad": "x" * 64}
+    assert len(wal2.messages_after_end_height(7)) == 0
+    wal2.close()
+
+
+def test_nil_wal():
+    w = NilWAL()
+    w.save({"type": "x"})
+    w.save_end_height(1)
+    assert w.all_messages() == []
+    assert w.messages_after_end_height(1) is None
