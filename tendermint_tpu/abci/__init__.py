@@ -1,0 +1,26 @@
+"""ABCI — the application boundary (reference: external abci dep + proxy/).
+
+The replicated application is decoupled from consensus behind a small
+request/response protocol. The reference speaks protobuf over a socket;
+this rebuild speaks the framework's canonical JSON over length-prefixed
+frames (one codec everywhere), with the same three logical connections
+(mempool / consensus / query — proxy/multi_app_conn.go:12-18) and the
+same method surface (echo, info, init_chain, check_tx, deliver_tx,
+begin_block, end_block, commit, query, set_option).
+
+  types.py   request/response dataclasses
+  app.py     Application base class + BaseApplication no-op defaults
+  client.py  AppConn clients: in-process Local + Socket
+  server.py  socket server hosting an Application
+  proxy.py   AppConns bundle + ClientCreator injection (proxy/client.go)
+  apps/      built-in example apps: kvstore, counter
+"""
+
+from tendermint_tpu.abci.types import (
+    CodeTypeOK, Request, Response, ResultCheckTx, ResultDeliverTx,
+    ResultInfo, ResultQuery, ValidatorUpdate,
+)
+from tendermint_tpu.abci.app import BaseApplication
+from tendermint_tpu.abci.client import AppConn, LocalClient, SocketClient
+from tendermint_tpu.abci.server import ABCIServer
+from tendermint_tpu.abci.proxy import AppConns, local_client_creator, socket_client_creator

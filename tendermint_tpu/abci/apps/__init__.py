@@ -1,0 +1,5 @@
+"""Built-in example applications (reference: abci's dummy + counter apps,
+driven by consensus tests via consensus/common_test.go)."""
+
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+from tendermint_tpu.abci.apps.counter import CounterApp

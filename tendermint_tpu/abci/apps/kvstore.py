@@ -1,0 +1,57 @@
+"""KVStore app — the reference's "dummy" app, upgraded with a Merkle state.
+
+Txs are "key=value" (or opaque bytes stored under themselves). The app hash
+is the Merkle root (ops/merkle) over sorted key=value leaves, so every
+committed height has a verifiable state commitment — what the reference's
+dummy app gets from its IAVL tree.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci.app import BaseApplication
+from tendermint_tpu.abci.types import (
+    ResultCheckTx, ResultDeliverTx, ResultInfo, ResultQuery,
+)
+from tendermint_tpu.ops import merkle
+
+
+class KVStoreApp(BaseApplication):
+    def __init__(self):
+        self.store: dict[bytes, bytes] = {}
+        self.height = 0
+        self.app_hash = b""
+        self.tx_count = 0
+
+    def info(self) -> ResultInfo:
+        return ResultInfo(data=f"kvstore:{len(self.store)}",
+                          version="1",
+                          last_block_height=self.height,
+                          last_block_app_hash=self.app_hash)
+
+    def check_tx(self, tx: bytes) -> ResultCheckTx:
+        if not tx:
+            return ResultCheckTx(code=1, log="empty tx")
+        return ResultCheckTx()
+
+    def deliver_tx(self, tx: bytes) -> ResultDeliverTx:
+        if not tx:
+            return ResultDeliverTx(code=1, log="empty tx")
+        if b"=" in tx:
+            k, _, v = tx.partition(b"=")
+        else:
+            k = v = tx
+        self.store[k] = v
+        self.tx_count += 1
+        return ResultDeliverTx(tags={"app.key": k.decode("utf-8", "replace")})
+
+    def commit(self) -> bytes:
+        self.height += 1
+        leaves = [k + b"=" + v for k, v in sorted(self.store.items())]
+        self.app_hash = merkle.root_host(leaves) if leaves else b"\x00" * 32
+        return self.app_hash
+
+    def query(self, path: str, data: bytes, height: int,
+              prove: bool) -> ResultQuery:
+        value = self.store.get(data, b"")
+        return ResultQuery(key=data, value=value, height=self.height,
+                           log="exists" if value else "does not exist")

@@ -1,0 +1,160 @@
+"""ABCI request/response types (replaces the reference's abci protobufs).
+
+Plain dataclasses with canonical-JSON object forms; the socket transport
+frames them exactly like every other persisted structure in this framework.
+Mirrors the protobuf surface used by the reference (types/protobuf.go,
+state/execution.go:163-241): Info, InitChain, BeginBlock, DeliverTx,
+EndBlock (validator updates + param updates), Commit, CheckTx, Query.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+CodeTypeOK = 0
+
+
+@dataclass
+class ValidatorUpdate:
+    """EndBlock validator diff: power 0 removes (state/execution.go:246)."""
+    pubkey: bytes
+    power: int
+
+    def to_obj(self):
+        return {"pubkey": self.pubkey.hex(), "power": self.power}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(bytes.fromhex(o["pubkey"]), o["power"])
+
+
+@dataclass
+class ResultInfo:
+    data: str = ""
+    version: str = ""
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+    def to_obj(self):
+        return {"data": self.data, "version": self.version,
+                "last_block_height": self.last_block_height,
+                "last_block_app_hash": self.last_block_app_hash.hex()}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["data"], o["version"], o["last_block_height"],
+                   bytes.fromhex(o["last_block_app_hash"]))
+
+
+@dataclass
+class ResultCheckTx:
+    code: int = CodeTypeOK
+    data: bytes = b""
+    log: str = ""
+    gas_wanted: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.code == CodeTypeOK
+
+    def to_obj(self):
+        return {"code": self.code, "data": self.data.hex(), "log": self.log,
+                "gas_wanted": self.gas_wanted}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["code"], bytes.fromhex(o["data"]), o["log"],
+                   o.get("gas_wanted", 0))
+
+
+@dataclass
+class ResultDeliverTx:
+    code: int = CodeTypeOK
+    data: bytes = b""
+    log: str = ""
+    tags: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.code == CodeTypeOK
+
+    def to_obj(self):
+        return {"code": self.code, "data": self.data.hex(), "log": self.log,
+                "tags": self.tags}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["code"], bytes.fromhex(o["data"]), o["log"],
+                   o.get("tags", {}))
+
+
+@dataclass
+class ResultEndBlock:
+    validator_updates: List[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: Optional[dict] = None
+    tags: dict = field(default_factory=dict)
+
+    def to_obj(self):
+        return {"validator_updates":
+                    [v.to_obj() for v in self.validator_updates],
+                "consensus_param_updates": self.consensus_param_updates,
+                "tags": self.tags}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls([ValidatorUpdate.from_obj(v)
+                    for v in o["validator_updates"]],
+                   o.get("consensus_param_updates"), o.get("tags", {}))
+
+
+@dataclass
+class ResultQuery:
+    code: int = CodeTypeOK
+    key: bytes = b""
+    value: bytes = b""
+    proof: bytes = b""
+    height: int = 0
+    log: str = ""
+
+    def to_obj(self):
+        return {"code": self.code, "key": self.key.hex(),
+                "value": self.value.hex(), "proof": self.proof.hex(),
+                "height": self.height, "log": self.log}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["code"], bytes.fromhex(o["key"]),
+                   bytes.fromhex(o["value"]), bytes.fromhex(o["proof"]),
+                   o["height"], o["log"])
+
+
+# Generic request/response envelopes for the socket transport. `method` maps
+# 1:1 onto Application methods; `payload` is method-specific plain obj.
+
+@dataclass
+class Request:
+    method: str
+    payload: Any = None
+
+    def to_obj(self):
+        return {"method": self.method, "payload": self.payload}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["method"], o.get("payload"))
+
+
+@dataclass
+class Response:
+    method: str
+    payload: Any = None
+    error: Optional[str] = None
+
+    def to_obj(self):
+        return {"method": self.method, "payload": self.payload,
+                "error": self.error}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(o["method"], o.get("payload"), o.get("error"))

@@ -1,0 +1,135 @@
+"""CLI (cmd/tendermint): init, node, version, show_validator,
+gen_validator, unsafe_reset_all. Testnet/replay/lite commands land with
+their subsystems."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+
+def cmd_init(args) -> int:
+    """Write genesis + priv validator + config skeleton (cmd init.go:48)."""
+    from tendermint_tpu.types import GenesisDoc, PrivValidatorFile
+    from tendermint_tpu.types.genesis import GenesisValidator
+    home = args.home
+    cfg_dir = os.path.join(home, "config")
+    os.makedirs(cfg_dir, exist_ok=True)
+    pv_path = os.path.join(cfg_dir, "priv_validator.json")
+    pv = PrivValidatorFile.load_or_generate(pv_path)
+    gen_path = os.path.join(cfg_dir, "genesis.json")
+    if not os.path.exists(gen_path):
+        gen = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{int(time.time())}",
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.pubkey.ed25519, 10)])
+        gen.save(gen_path)
+        print(f"initialized genesis at {gen_path}")
+    else:
+        print(f"genesis already exists at {gen_path}")
+    print(f"priv validator at {pv_path}")
+    return 0
+
+
+def cmd_node(args) -> int:
+    """Run a (single-process) node committing blocks (cmd run_node.go)."""
+    from tendermint_tpu.node import default_node
+    from tendermint_tpu.abci.apps import CounterApp, KVStoreApp
+    app = {"kvstore": KVStoreApp, "counter": CounterApp}[args.app]()
+    node = default_node(args.home, app=app)
+    node.start()
+    print(f"node started: chain={node.gen_doc.chain_id} "
+          f"height={node.height}", flush=True)
+    try:
+        last = -1
+        deadline = time.time() + args.max_seconds if args.max_seconds else None
+        while True:
+            time.sleep(0.2)
+            if node.height != last:
+                last = node.height
+                print(f"committed height={last} "
+                      f"app_hash={node.consensus.state.app_hash.hex()[:16]}",
+                      flush=True)
+            if deadline and time.time() > deadline:
+                break
+            if args.max_height and node.height >= args.max_height:
+                break
+    except KeyboardInterrupt:
+        pass
+    node.stop()
+    print(f"node stopped at height {node.height}")
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from tendermint_tpu.types import PrivValidatorFile
+    pv = PrivValidatorFile.load(
+        os.path.join(args.home, "config", "priv_validator.json"))
+    print(json.dumps(pv.pubkey.to_obj()))
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+    from tendermint_tpu.types.keys import PrivKey
+    key = PrivKey.generate()
+    print(json.dumps({"priv_key": key.to_obj(),
+                      "pub_key": key.pubkey.to_obj()}))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """Wipe data dir, keep genesis + reset priv validator height state."""
+    data = os.path.join(args.home, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+        print(f"removed {data}")
+    pv_path = os.path.join(args.home, "config", "priv_validator.json")
+    if os.path.exists(pv_path):
+        from tendermint_tpu.types import PrivValidatorFile
+        pv = PrivValidatorFile.load(pv_path)
+        pv.last_height = pv.last_round = pv.last_step = 0
+        pv.last_sign_bytes = None
+        pv.last_signature = None
+        pv._persist()
+        print(f"reset priv validator sign state at {pv_path}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    from tendermint_tpu import __version__
+    print(__version__)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tendermint_tpu")
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="initialize genesis + priv validator")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("node", help="run a node")
+    sp.add_argument("--app", default="kvstore",
+                    choices=["kvstore", "counter"])
+    sp.add_argument("--max-height", type=int, default=0)
+    sp.add_argument("--max-seconds", type=float, default=0)
+    sp.set_defaults(fn=cmd_node)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("show_validator").set_defaults(fn=cmd_show_validator)
+    sub.add_parser("gen_validator").set_defaults(fn=cmd_gen_validator)
+    sub.add_parser("unsafe_reset_all").set_defaults(fn=cmd_unsafe_reset_all)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""Configuration tree (config/config.go:35-44).
+
+One Config value with per-subsystem sections; consensus timeouts are
+round-scaled functions exactly like the reference's (config/config.go:
+364-385: propose 3000+500·round ms, prevote/precommit 1000+500·round ms,
+commit 1000 ms). test_config() shrinks everything for fast in-process
+nets, mirroring config.TestConfig.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_dir: str = "data"
+    log_level: str = "info"
+    prof_laddr: str = ""
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://0.0.0.0:46657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:46656"
+    seeds: str = ""
+    persistent_peers: str = ""
+    max_num_peers: int = 50
+    flush_throttle_ms: int = 100
+    max_msg_packet_payload_size: int = 1024
+    send_rate: int = 512000  # B/s (p2p/conn/connection.go:33-35)
+    recv_rate: int = 512000
+    pex: bool = True
+    seed_mode: bool = False
+    addr_book_strict: bool = True
+    handshake_timeout_s: float = 20.0
+    dial_timeout_s: float = 3.0
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = "data/mempool.wal"
+    size: int = 100000
+    cache_size: int = 100000
+
+
+@dataclass
+class ConsensusConfig:
+    wal_path: str = "data/cs.wal/wal"
+    wal_light: bool = False
+    # base timeouts in ms (config/config.go defaults)
+    timeout_propose: int = 3000
+    timeout_propose_delta: int = 500
+    timeout_prevote: int = 1000
+    timeout_prevote_delta: int = 500
+    timeout_precommit: int = 1000
+    timeout_precommit_delta: int = 500
+    timeout_commit: int = 1000
+    skip_timeout_commit: bool = False
+    max_block_size_txs: int = 10000
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: int = 0  # seconds
+    peer_gossip_sleep_ms: int = 100
+    peer_query_maj23_sleep_ms: int = 2000
+
+    def propose_timeout_s(self, round_: int) -> float:
+        return (self.timeout_propose
+                + self.timeout_propose_delta * round_) / 1000.0
+
+    def prevote_timeout_s(self, round_: int) -> float:
+        return (self.timeout_prevote
+                + self.timeout_prevote_delta * round_) / 1000.0
+
+    def precommit_timeout_s(self, round_: int) -> float:
+        return (self.timeout_precommit
+                + self.timeout_precommit_delta * round_) / 1000.0
+
+    def commit_timeout_s(self) -> float:
+        return self.timeout_commit / 1000.0
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"           # kv | null
+    index_tags: str = ""
+    index_all_tags: bool = False
+
+
+@dataclass
+class Config:
+    home: str = ""
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+
+    def path(self, *parts: str) -> str:
+        return os.path.join(self.home, *parts)
+
+
+def default_config(home: str = "") -> Config:
+    return Config(home=home)
+
+
+def test_config(home: str = "") -> Config:
+    """All consensus timeouts shrunk ~30x (config.TestConfig)."""
+    c = Config(home=home)
+    c.consensus = replace(
+        c.consensus,
+        timeout_propose=100, timeout_propose_delta=1,
+        timeout_prevote=10, timeout_prevote_delta=1,
+        timeout_precommit=10, timeout_precommit_delta=1,
+        timeout_commit=10, skip_timeout_commit=True,
+        peer_gossip_sleep_ms=5, peer_query_maj23_sleep_ms=250)
+    return c

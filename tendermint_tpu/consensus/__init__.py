@@ -1,0 +1,20 @@
+"""Consensus — the Tendermint BFT state machine (reference consensus/ pkg).
+
+  rstate.py   round steps, RoundState, HeightVoteSet (consensus/types/)
+  ticker.py   single-timer timeout scheduler        (consensus/ticker.go)
+  state.py    ConsensusState event loop             (consensus/state.go)
+  replay.py   WAL catchup replay + ABCI handshake   (consensus/replay.go)
+
+Design: the reference serializes everything through one receiveRoutine
+goroutine; here ConsensusState is an explicitly-stepped deterministic
+machine — inputs (messages, timeouts) are handled on one thread, effects
+(gossip messages, scheduled timeouts, committed blocks) are emitted through
+injectable sinks. The same handle() path serves live operation, WAL
+replay and tests; determinism is the point, not an optimization.
+"""
+
+from tendermint_tpu.consensus.rstate import (
+    HeightVoteSet, RoundState, Step,
+)
+from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker, MockTicker
+from tendermint_tpu.consensus.state import ConsensusState

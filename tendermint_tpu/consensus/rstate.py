@@ -1,0 +1,121 @@
+"""Round state types (consensus/types/state.go, height_vote_set.go)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tendermint_tpu.types.block import Block, BlockID, Commit
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+from tendermint_tpu.types.vote_set import VoteSet
+
+
+class Step(enum.IntEnum):
+    """consensus/types/state.go:16-26."""
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+
+@dataclass
+class POLInfo:
+    """Proof-of-lock: the round + block of a +2/3 prevote majority."""
+    round: int
+    block_id: BlockID
+
+
+class HeightVoteSet:
+    """round → {prevotes, precommits} for one height
+    (consensus/types/height_vote_set.go:32-129). Peer catch-up votes may
+    create vote sets up to 2 rounds beyond the current round — enough to
+    learn about skips without unbounded memory."""
+
+    MAX_CATCHUP_ROUNDS = 2
+
+    def __init__(self, chain_id: str, height: int, valset: ValidatorSet,
+                 verifier=None):
+        self.chain_id = chain_id
+        self.height = height
+        self.valset = valset
+        self.verifier = verifier
+        self.round = 0
+        self._sets: Dict[tuple, VoteSet] = {}
+        self.set_round(0)
+
+    def _make(self, round_: int) -> None:
+        for t in (VoteType.PREVOTE, VoteType.PRECOMMIT):
+            if (round_, t) not in self._sets:
+                self._sets[(round_, t)] = VoteSet(
+                    self.chain_id, self.height, round_, t, self.valset,
+                    verifier=self.verifier)
+
+    def set_round(self, round_: int) -> None:
+        self._make(round_)
+        self._make(round_ + 1)  # catchup room, as the reference pre-makes
+        self.round = max(self.round, round_)
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        return self._sets.get((round_, VoteType.PREVOTE))
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        return self._sets.get((round_, VoteType.PRECOMMIT))
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+        vs = self._sets.get((vote.round, vote.type))
+        if vs is None:
+            if vote.round > self.round + self.MAX_CATCHUP_ROUNDS and peer_id:
+                raise ValueError(
+                    f"vote round {vote.round} too far beyond {self.round}")
+            self._make(vote.round)
+            vs = self._sets[(vote.round, vote.type)]
+        return vs.add_vote(vote)
+
+    def pol_info(self) -> Optional[POLInfo]:
+        """Highest round with a +2/3 prevote majority for a block
+        (consensus/types/height_vote_set.go:145)."""
+        for r in sorted({r for r, t in self._sets
+                         if t == VoteType.PREVOTE}, reverse=True):
+            maj = self._sets[(r, VoteType.PREVOTE)].two_thirds_majority()
+            if maj is not None and not maj.is_zero():
+                return POLInfo(r, maj)
+        return None
+
+    def set_peer_maj23(self, round_: int, type_: int, peer_id: str,
+                       block_id: BlockID) -> None:
+        self._make(round_)
+        self._sets[(round_, type_)].set_peer_maj23(peer_id, block_id)
+
+
+@dataclass
+class RoundState:
+    """consensus/types/state.go:60-77 — everything mutable about the
+    current height/round."""
+    height: int = 1
+    round: int = 0
+    step: Step = Step.NEW_HEIGHT
+    start_time_ns: int = 0
+    commit_time_ns: int = 0
+    validators: Optional[ValidatorSet] = None
+    proposal: Optional[Proposal] = None
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[PartSet] = None
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[PartSet] = None
+    votes: Optional[HeightVoteSet] = None
+    commit_round: int = -1
+    last_commit: Optional[VoteSet] = None
+    last_validators: Optional[ValidatorSet] = None
+
+    def round_state_event_obj(self) -> dict:
+        return {"height": self.height, "round": self.round,
+                "step": int(self.step)}

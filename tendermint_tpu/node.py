@@ -1,0 +1,97 @@
+"""Node — wires stores, app conns, handshake, WAL and consensus together
+(node/node.go:121-353, single-process subset; p2p/rpc attach in later
+stages via the same hooks)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+from tendermint_tpu.config import Config
+from tendermint_tpu.consensus.replay import Handshaker, catchup_replay
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.ticker import TimeoutTicker
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.storage import WAL, BlockStore, StateStore, open_db
+from tendermint_tpu.types import GenesisDoc, PrivValidatorFile
+from tendermint_tpu.types.events import EventBus
+
+
+class Node:
+    def __init__(self, config: Config, gen_doc: GenesisDoc,
+                 priv_validator=None, app=None, client_creator=None,
+                 mempool=None, evidence_pool=None, in_memory=False):
+        self.config = config
+        self.gen_doc = gen_doc
+
+        def db_path(name):
+            if in_memory:
+                return None
+            p = config.path(config.base.db_dir)
+            os.makedirs(p, exist_ok=True)
+            return os.path.join(p, name + ".db")
+
+        self.block_store = BlockStore(open_db(db_path("blockstore")))
+        self.state_store = StateStore(open_db(db_path("state")))
+
+        if client_creator is None:
+            if app is None:
+                from tendermint_tpu.abci.apps import KVStoreApp
+                app = KVStoreApp()
+            client_creator = local_client_creator(app)
+        self.app = app
+        self.app_conns = AppConns(client_creator)
+
+        # ABCI handshake: sync app with stores (consensus/replay.go:211)
+        handshaker = Handshaker(self.state_store, self.block_store, gen_doc)
+        state = handshaker.handshake(self.app_conns)
+
+        self.event_bus = EventBus()
+        block_exec = BlockExecutor(
+            self.state_store, self.app_conns.consensus,
+            mempool=mempool, evidence_pool=evidence_pool,
+            event_bus=self.event_bus)
+
+        if in_memory:
+            from tendermint_tpu.storage.wal import NilWAL
+            self.wal = NilWAL()
+        else:
+            self.wal = WAL(config.path(config.consensus.wal_path),
+                           light=config.consensus.wal_light)
+
+        self.consensus = ConsensusState(
+            config.consensus, state, block_exec, self.block_store,
+            mempool=mempool, evidence_pool=evidence_pool,
+            priv_validator=priv_validator, wal=self.wal,
+            event_bus=self.event_bus, ticker_factory=TimeoutTicker)
+
+    def start(self) -> None:
+        # WAL catchup for the in-flight height (consensus/replay.go:93)
+        try:
+            catchup_replay(self.consensus, self.wal)
+        except ValueError:
+            pass  # empty/fresh WAL
+        self.consensus.start()
+
+    def stop(self) -> None:
+        self.consensus.stop()
+        self.app_conns.close()
+        if hasattr(self.wal, "close"):
+            self.wal.close()
+
+    @property
+    def height(self) -> int:
+        return self.consensus.state.last_block_height
+
+
+def default_node(home: str, app=None, in_memory=False) -> Node:
+    """DefaultNewNode (node/node.go:79): load config tree from `home`."""
+    from tendermint_tpu.config import default_config
+    config = default_config(home)
+    gen_doc = GenesisDoc.load(os.path.join(home, "config", "genesis.json"))
+    pv = PrivValidatorFile.load_or_generate(
+        os.path.join(home, "config", "priv_validator.json"))
+    return Node(config, gen_doc, priv_validator=pv, app=app,
+                in_memory=in_memory)

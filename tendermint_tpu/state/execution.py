@@ -1,0 +1,209 @@
+"""BlockExecutor — the only path that mutates replicated state
+(state/execution.go:21-382).
+
+apply_block: validate → execute txs on the ABCI consensus connection →
+save ABCI responses → update validator set / params from EndBlock →
+Commit the app with the mempool locked → save state → fire events.
+exec_commit_block is the stateless variant used by fast-sync and
+handshake replay (state/execution.go:368).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Protocol
+
+from tendermint_tpu.abci.types import ResultDeliverTx, ValidatorUpdate
+from tendermint_tpu.ops import merkle
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.validation import BlockValidationError, validate_block
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.block import Block, BlockID
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.validator_set import Validator
+
+
+class Mempool(Protocol):
+    """What consensus needs from a mempool (types/services.go:21)."""
+
+    def lock(self) -> None: ...
+    def unlock(self) -> None: ...
+    def size(self) -> int: ...
+    def check_tx(self, tx: bytes) -> object: ...
+    def reap(self, max_txs: int) -> List[bytes]: ...
+    def update(self, height: int, txs: List[bytes]) -> None: ...
+    def flush(self) -> None: ...
+
+
+class MockMempool:
+    """No-op mempool (types/services.go:38)."""
+
+    def lock(self) -> None: ...
+    def unlock(self) -> None: ...
+    def size(self) -> int: return 0
+    def check_tx(self, tx: bytes) -> object: return None
+    def reap(self, max_txs: int) -> List[bytes]: return []
+    def update(self, height: int, txs: List[bytes]) -> None: ...
+    def flush(self) -> None: ...
+
+
+class EvidencePool(Protocol):
+    """types/services.go:80."""
+
+    def pending_evidence(self) -> List: ...
+    def add_evidence(self, ev) -> None: ...
+    def update(self, block: Block) -> None: ...
+
+
+class MockEvidencePool:
+    def pending_evidence(self) -> List: return []
+    def add_evidence(self, ev) -> None: ...
+    def update(self, block: Block) -> None: ...
+
+
+def results_hash(results: List[ResultDeliverTx]) -> bytes:
+    """Deterministic hash of (code, data) per tx → LastResultsHash
+    (types/results.go:20-49)."""
+    leaves = [encoding.cdumps({"code": r.code, "data": r.data.hex()})
+              for r in results]
+    return merkle.root_host(leaves)
+
+
+class ABCIResponses:
+    """Responses from one block's execution; persisted for replay-without-
+    app and the results hash (state/store.go:127)."""
+
+    def __init__(self, deliver_txs: List[ResultDeliverTx],
+                 end_block_obj: dict):
+        self.deliver_txs = deliver_txs
+        self.end_block_obj = end_block_obj
+
+    def results_hash(self) -> bytes:
+        return results_hash(self.deliver_txs)
+
+    def to_obj(self):
+        return {"deliver_txs": [r.to_obj() for r in self.deliver_txs],
+                "end_block": self.end_block_obj}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls([ResultDeliverTx.from_obj(r) for r in o["deliver_txs"]],
+                   o["end_block"])
+
+
+def exec_block_on_app(app_conn, block: Block,
+                      valset=None) -> ABCIResponses:
+    """BeginBlock → batched DeliverTx → EndBlock
+    (state/execution.go:163-241). Absent validators = those whose precommit
+    is missing from LastCommit."""
+    absent = []
+    if valset is not None and block.last_commit.size() > 0:
+        absent = [i for i, pc in enumerate(block.last_commit.precommits)
+                  if pc is None]
+    app_conn.begin_block(block.hash(), block.header.to_obj(),
+                         absent_validators=absent)
+    deliver_txs = app_conn.deliver_tx_batch(block.data.txs)
+    end = app_conn.end_block(block.header.height)
+    return ABCIResponses(deliver_txs, end.to_obj())
+
+
+class BlockExecutor:
+    def __init__(self, state_store, app_conn_consensus,
+                 mempool: Optional[Mempool] = None,
+                 evidence_pool: Optional[EvidencePool] = None,
+                 event_bus=None, verifier=None):
+        self.state_store = state_store
+        self.app_conn = app_conn_consensus
+        self.mempool = mempool or MockMempool()
+        self.evidence_pool = evidence_pool or MockEvidencePool()
+        self.event_bus = event_bus
+        self.verifier = verifier
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, state_store=self.state_store,
+                       verifier=self.verifier)
+
+    def apply_block(self, state: State, block_id: BlockID,
+                    block: Block) -> State:
+        """state/execution.go:71-119. Returns the new State; raises
+        BlockValidationError on an invalid block."""
+        self.validate_block(state, block)
+        responses = exec_block_on_app(self.app_conn, block, state.validators)
+        if self.state_store is not None:
+            self.state_store.save_abci_responses(
+                block.header.height, responses.to_obj())
+        new_state = update_state(state, block_id, block, responses)
+
+        # Commit app + update mempool under the mempool lock
+        # (state/execution.go:125-156): no CheckTx may interleave between
+        # app Commit and mempool.update.
+        self.mempool.lock()
+        try:
+            app_hash = self.app_conn.commit()
+            self.mempool.update(block.header.height, block.data.txs)
+        finally:
+            self.mempool.unlock()
+
+        new_state.app_hash = app_hash
+        if self.state_store is not None:
+            self.state_store.save(new_state)
+        self.evidence_pool.update(block)
+        if self.event_bus is not None:
+            fire_events(self.event_bus, block, block_id, responses)
+        return new_state
+
+    def exec_commit_block(self, block: Block) -> bytes:
+        """Execute + commit WITHOUT state updates — fast-sync / handshake
+        replay (state/execution.go:368)."""
+        exec_block_on_app(self.app_conn, block)
+        return self.app_conn.commit()
+
+
+def update_state(state: State, block_id: BlockID, block: Block,
+                 responses: ABCIResponses) -> State:
+    """state/execution.go:286-338: next State value (app_hash filled by
+    caller after app Commit)."""
+    h = block.header.height
+    end = responses.end_block_obj
+
+    validators = state.validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    updates = [ValidatorUpdate.from_obj(u)
+               for u in end.get("validator_updates", [])]
+    if updates:
+        validators = validators.update_with_changes(
+            [Validator(u.pubkey, u.power) for u in updates])
+        last_height_vals_changed = h + 1
+
+    params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    if end.get("consensus_param_updates"):
+        params = params.update(end["consensus_param_updates"])
+        params.validate()
+        last_height_params_changed = h + 1
+
+    validators.increment_accum(1)
+
+    new_state = state.copy()
+    new_state.last_block_height = h
+    new_state.last_block_total_tx = \
+        state.last_block_total_tx + block.header.num_txs
+    new_state.last_block_id = block_id
+    new_state.last_block_time_ns = block.header.time_ns
+    new_state.last_validators = state.validators.copy()
+    new_state.validators = validators
+    new_state.last_height_validators_changed = last_height_vals_changed
+    new_state.consensus_params = params
+    new_state.last_height_consensus_params_changed = last_height_params_changed
+    new_state.last_results_hash = responses.results_hash()
+    return new_state
+
+
+def fire_events(event_bus, block: Block, block_id: BlockID,
+                responses: ABCIResponses) -> None:
+    """state/execution.go:343: NewBlock + NewBlockHeader + one EventTx per
+    tx with its DeliverTx result."""
+    event_bus.publish_new_block(block, block_id)
+    event_bus.publish_new_block_header(block.header)
+    for i, tx in enumerate(block.data.txs):
+        event_bus.publish_tx(block.header.height, i, tx,
+                             responses.deliver_txs[i])

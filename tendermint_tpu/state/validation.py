@@ -1,0 +1,92 @@
+"""Block-vs-state validation (state/validation.go).
+
+All header fields are checked against the current State; the block's
+LastCommit is verified with ONE batched signature verification
+(state/validation.go:69 → the VerifyCommit hot loop, here
+ValidatorSet.verify_commit on the BatchVerifier); evidence is verified
+against the historical validator set of its height.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.state.state import State
+from tendermint_tpu.types.block import Block
+
+
+class BlockValidationError(Exception):
+    pass
+
+
+def validate_block(state: State, block: Block, state_store=None,
+                   verifier=None) -> None:
+    """state/validation.go:15-122."""
+    try:
+        block.validate_basic()
+    except ValueError as e:
+        raise BlockValidationError(f"invalid block: {e}") from e
+    h = block.header
+
+    def check(cond: bool, what: str, want, got) -> None:
+        if not cond:
+            raise BlockValidationError(
+                f"wrong {what}: expected {want!r}, got {got!r}")
+
+    check(h.chain_id == state.chain_id, "chain_id", state.chain_id, h.chain_id)
+    check(h.height == state.last_block_height + 1, "height",
+          state.last_block_height + 1, h.height)
+    check(h.last_block_id == state.last_block_id, "last_block_id",
+          state.last_block_id, h.last_block_id)
+    check(h.total_txs == state.last_block_total_tx + h.num_txs, "total_txs",
+          state.last_block_total_tx + h.num_txs, h.total_txs)
+    check(h.app_hash == state.app_hash, "app_hash",
+          state.app_hash.hex(), h.app_hash.hex())
+    check(h.last_results_hash == state.last_results_hash, "last_results_hash",
+          state.last_results_hash.hex(), h.last_results_hash.hex())
+    check(h.validators_hash == state.validators.hash(), "validators_hash",
+          state.validators.hash().hex(), h.validators_hash.hex())
+    check(h.consensus_hash == state.consensus_params.hash(), "consensus_hash",
+          state.consensus_params.hash().hex(), h.consensus_hash.hex())
+
+    # LastCommit: height 1 has none; otherwise +2/3 of LastValidators —
+    # the batched signature hot path
+    if h.height == 1:
+        if block.last_commit.size() != 0:
+            raise BlockValidationError("block 1 cannot have a last_commit")
+    else:
+        if block.last_commit.size() != len(state.last_validators):
+            raise BlockValidationError(
+                f"last_commit size {block.last_commit.size()} != "
+                f"last validators {len(state.last_validators)}")
+        try:
+            state.last_validators.verify_commit(
+                state.chain_id, state.last_block_id,
+                state.last_block_height, block.last_commit,
+                verifier=verifier)
+        except ValueError as e:
+            raise BlockValidationError(f"invalid last_commit: {e}") from e
+
+    for ev in block.evidence.evidence:
+        verify_evidence(state, ev, state_store, verifier=verifier)
+
+
+def verify_evidence(state: State, evidence, state_store=None,
+                    verifier=None) -> None:
+    """state/validation.go:90-122: age window + the accused must have been
+    a validator at the evidence height (historical valset lookup)."""
+    height = state.last_block_height + 1
+    ev_height = evidence.height()
+    max_age = state.consensus_params.evidence.max_age
+    if ev_height < 1 or height - ev_height > max_age:
+        raise BlockValidationError(
+            f"evidence from height {ev_height} is too old (block {height}, "
+            f"max age {max_age})")
+    if state_store is not None:
+        valset = state_store.load_validators(ev_height)
+    else:
+        valset = state.validators
+    _, val = valset.get_by_address(evidence.address())
+    if val is None:
+        raise BlockValidationError(
+            f"address {evidence.address().hex()} was not a validator at "
+            f"height {ev_height}")
+    evidence.verify(state.chain_id, val.pubkey, verifier=verifier)

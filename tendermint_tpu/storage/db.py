@@ -72,6 +72,8 @@ class SQLiteDB:
     def __init__(self, path: str):
         self.path = path
         self._local = threading.local()
+        self._all_cons: list[sqlite3.Connection] = []
+        self._cons_lock = threading.Lock()
         con = self._con()
         con.execute("CREATE TABLE IF NOT EXISTS kv"
                     " (k BLOB PRIMARY KEY, v BLOB NOT NULL)")
@@ -84,6 +86,8 @@ class SQLiteDB:
             con.execute("PRAGMA journal_mode=WAL")
             con.execute("PRAGMA synchronous=NORMAL")
             self._local.con = con
+            with self._cons_lock:
+                self._all_cons.append(con)
         return con
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -122,10 +126,17 @@ class SQLiteDB:
         yield from cur
 
     def close(self) -> None:
-        con = getattr(self._local, "con", None)
-        if con is not None:
-            con.close()
-            self._local.con = None
+        # close EVERY thread's connection, not just the caller's —
+        # sqlite3 connections are safe to close from another thread as
+        # long as no statement is executing
+        with self._cons_lock:
+            cons, self._all_cons = self._all_cons, []
+        for con in cons:
+            try:
+                con.close()
+            except sqlite3.ProgrammingError:
+                pass
+        self._local.con = None
 
 
 def open_db(path: Optional[str]) -> KVStore:

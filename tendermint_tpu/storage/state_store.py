@@ -41,14 +41,18 @@ class StateStore:
     def save(self, state: State) -> None:
         """Save state + the NEXT height's valset/params rows, as the
         reference does: state written at height H describes validators that
-        will sign H+1."""
+        will sign H+1. One atomic batch: a crash must not leave a
+        valset/params row without its state row."""
         next_h = state.last_block_height + 1
-        self._save_validators_info(
-            next_h, state.last_height_validators_changed, state.validators)
-        self._save_params_info(
-            next_h, state.last_height_consensus_params_changed,
-            state.consensus_params)
-        self.db.set(_STATE_KEY, encoding.cdumps(state.to_obj()))
+        self.db.set_batch([
+            self._validators_info_pair(
+                next_h, state.last_height_validators_changed,
+                state.validators),
+            self._params_info_pair(
+                next_h, state.last_height_consensus_params_changed,
+                state.consensus_params),
+            (_STATE_KEY, encoding.cdumps(state.to_obj())),
+        ])
 
     def load(self) -> Optional[State]:
         raw = self.db.get(_STATE_KEY)
@@ -64,21 +68,23 @@ class StateStore:
                     f"stored chain_id {s.chain_id!r} != genesis "
                     f"{gen_doc.chain_id!r}")
             return s
+        if gen_doc is None:
+            raise ValueError("no stored state and no genesis doc provided")
         state = make_genesis_state(gen_doc)
         self.save(state)
         return state
 
     # -- historical validators (state/store.go:168-230) ----------------------
 
-    def _save_validators_info(self, height: int, last_changed: int,
-                              valset: ValidatorSet) -> None:
+    def _validators_info_pair(self, height: int, last_changed: int,
+                              valset: ValidatorSet) -> tuple[bytes, bytes]:
         if last_changed > height:
             raise ValueError("last_changed cannot exceed height")
         if last_changed == height:
             obj = {"last_changed": last_changed, "valset": valset.to_obj()}
         else:
             obj = {"last_changed": last_changed, "valset": None}
-        self.db.set(_validators_key(height), encoding.cdumps(obj))
+        return _validators_key(height), encoding.cdumps(obj)
 
     def load_validators(self, height: int) -> ValidatorSet:
         """Validator set that signs blocks at `height` (one indirection)."""
@@ -95,11 +101,11 @@ class StateStore:
 
     # -- historical consensus params -----------------------------------------
 
-    def _save_params_info(self, height: int, last_changed: int,
-                          params: ConsensusParams) -> None:
+    def _params_info_pair(self, height: int, last_changed: int,
+                          params: ConsensusParams) -> tuple[bytes, bytes]:
         obj = {"last_changed": last_changed,
                "params": params.to_obj() if last_changed == height else None}
-        self.db.set(_params_key(height), encoding.cdumps(obj))
+        return _params_key(height), encoding.cdumps(obj)
 
     def load_consensus_params(self, height: int) -> ConsensusParams:
         o = self._load(_params_key(height))

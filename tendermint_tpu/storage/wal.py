@@ -152,7 +152,11 @@ class WAL:
         tail: list[WALMessage] = []
         for path in self._files_newest_first():
             with open(path, "rb") as f:
-                msgs = list(decode_frames(f.read()))
+                # only the head file may legitimately end mid-frame (crash
+                # during write); a truncated backup is real corruption
+                msgs = list(decode_frames(
+                    f.read(),
+                    tolerate_truncated_tail=(path == self.path)))
             found_at = None
             for i in range(len(msgs) - 1, -1, -1):
                 m = msgs[i]
@@ -169,7 +173,9 @@ class WAL:
         out: list[WALMessage] = []
         for path in reversed(self._files_newest_first()):
             with open(path, "rb") as f:
-                out.extend(decode_frames(f.read()))
+                out.extend(decode_frames(
+                    f.read(),
+                    tolerate_truncated_tail=(path == self.path)))
         return out
 
 

@@ -1,0 +1,123 @@
+"""ABCI boundary tests: local/socket clients, server, example apps.
+
+Models the reference's ABCI conformance tests (test/app/counter_test.sh,
+dummy_test.sh) in-process: the same app driven over both transports must
+behave identically.
+"""
+
+import threading
+
+import pytest
+
+from tendermint_tpu.abci import (
+    ABCIServer, AppConns, LocalClient, SocketClient, local_client_creator,
+    socket_client_creator,
+)
+from tendermint_tpu.abci.apps import CounterApp, KVStoreApp
+from tendermint_tpu.abci.client import ABCIClientError
+from tendermint_tpu.abci.types import ValidatorUpdate
+
+
+@pytest.fixture
+def socket_kvstore():
+    app = KVStoreApp()
+    server = ABCIServer(app, "127.0.0.1:0")
+    server.start()
+    yield app, f"127.0.0.1:{server.bound_port}"
+    server.stop()
+
+
+def _drive_kvstore(conn):
+    assert conn.echo("hello") == "hello"
+    info = conn.info()
+    assert info.last_block_height == 0
+
+    assert conn.check_tx(b"a=1").ok
+    assert not conn.check_tx(b"").ok
+
+    conn.init_chain([ValidatorUpdate(b"\x01" * 32, 10)], "chain")
+    conn.begin_block(b"\xaa" * 32, {"height": 1})
+    r = conn.deliver_tx(b"name=satoshi")
+    assert r.ok and r.tags["app.key"] == "name"
+    conn.end_block(1)
+    h1 = conn.commit()
+    assert len(h1) == 32
+
+    q = conn.query("/store", b"name", 0, False)
+    assert q.value == b"satoshi"
+
+    # second block changes the app hash
+    conn.begin_block(b"\xbb" * 32, {"height": 2})
+    batch = conn.deliver_tx_batch([b"k%d=v%d" % (i, i) for i in range(10)])
+    assert all(r.ok for r in batch)
+    conn.end_block(2)
+    h2 = conn.commit()
+    assert h2 != h1
+    assert conn.info().last_block_height == 2
+
+
+def test_kvstore_local():
+    _drive_kvstore(LocalClient(KVStoreApp()))
+
+
+def test_kvstore_socket(socket_kvstore):
+    _, addr = socket_kvstore
+    conn = SocketClient(addr)
+    _drive_kvstore(conn)
+    conn.close()
+
+
+def test_counter_serial_semantics():
+    conn = LocalClient(CounterApp(serial=True))
+    assert conn.deliver_tx((0).to_bytes(8, "big")).ok
+    assert conn.deliver_tx((1).to_bytes(8, "big")).ok
+    r = conn.deliver_tx((5).to_bytes(8, "big"))
+    assert not r.ok and "expected 2" in r.log
+    # check_tx rejects stale values only
+    assert not conn.check_tx((0).to_bytes(8, "big")).ok
+    assert conn.check_tx((2).to_bytes(8, "big")).ok
+    assert conn.query("tx", b"", 0, False).value == b"2"
+
+
+def test_app_conns_three_connections_share_app():
+    app = KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    conns.consensus.deliver_tx(b"x=1")
+    conns.consensus.commit()
+    assert conns.query.query("/store", b"x", 0, False).value == b"1"
+    assert conns.mempool.check_tx(b"y=2").ok
+    conns.close()
+
+
+def test_socket_server_error_propagation(socket_kvstore):
+    _, addr = socket_kvstore
+    conn = SocketClient(addr)
+    with pytest.raises(ABCIClientError, match="unknown ABCI method"):
+        conn._call("bogus_method")
+    # connection still usable afterwards
+    assert conn.echo("still-alive") == "still-alive"
+    conn.close()
+
+
+def test_socket_concurrent_connections(socket_kvstore):
+    """Three logical conns hammering one app server stay consistent."""
+    _, addr = socket_kvstore
+    conns = AppConns(socket_client_creator(addr))
+    errs = []
+
+    def spam_checks():
+        try:
+            for _ in range(50):
+                assert conns.mempool.check_tx(b"t=1").ok
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    t = threading.Thread(target=spam_checks)
+    t.start()
+    for i in range(20):
+        conns.consensus.deliver_tx(b"c%d=1" % i)
+    conns.consensus.commit()
+    t.join()
+    assert not errs
+    assert conns.query.info().last_block_height == 1
+    conns.close()

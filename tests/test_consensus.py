@@ -1,0 +1,179 @@
+"""Consensus state machine tests (models consensus/state_test.go +
+reactor_test.go behaviors, in-process, deterministic via MockTicker).
+
+The net harness wires N ConsensusStates directly through their broadcast
+hooks — the reference's randConsensusNet over in-memory connections
+(consensus/common_test.go:343)."""
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.consensus import ConsensusState, MockTicker, Step
+from tendermint_tpu.consensus.ticker import TimeoutInfo
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import make_genesis_state
+from tendermint_tpu.storage import BlockStore, MemDB, StateStore
+from tendermint_tpu.types import (
+    GenesisDoc, GenesisValidator, PrivKey,
+)
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+
+def make_node(gen_doc, key=None, app=None):
+    """One in-process validator node around a KVStore app."""
+    app = app or KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_store.load_or_genesis(gen_doc)
+    # InitChain equivalent at genesis
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], gen_doc.chain_id)
+    exec_ = BlockExecutor(state_store, conns.consensus)
+    cs = ConsensusState(
+        make_test_config().consensus, state, exec_, block_store,
+        priv_validator=PrivValidator(LocalSigner(key)) if key else None,
+        ticker_factory=MockTicker)
+    return cs
+
+
+def make_net(n, chain_id="cs-test"):
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    gen = GenesisDoc(chain_id=chain_id, genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    nodes = [make_node(gen, k) for k in keys]
+    # full-mesh wiring: every broadcast goes to every OTHER node
+    for i, src in enumerate(nodes):
+        def relay(msg, i=i):
+            for j, dst in enumerate(nodes):
+                if j != i and msg["type"] in ("proposal", "block_part", "vote"):
+                    dst.submit(dict(msg), peer_id=f"node{i}")
+        src.broadcast_hooks.append(relay)
+    return nodes, keys
+
+
+def fire_all(nodes):
+    """Deliver every pending mock timeout once; returns #fired."""
+    n = 0
+    for node in nodes:
+        if node.ticker.fire_next() is not None:
+            n += 1
+    return n
+
+
+def run_until_height(nodes, height, max_ticks=200):
+    for _ in range(max_ticks):
+        if all(n.state.last_block_height >= height for n in nodes):
+            return
+        if fire_all(nodes) == 0 and \
+                all(n.state.last_block_height >= height for n in nodes):
+            return
+    raise AssertionError(
+        f"net did not reach height {height}; at "
+        f"{[n.state.last_block_height for n in nodes]}, steps "
+        f"{[(n.rs.height, n.rs.round, int(n.rs.step)) for n in nodes]}")
+
+
+def test_single_validator_commits_blocks():
+    nodes, _ = make_net(1)
+    cs = nodes[0]
+    committed = []
+    cs.decided_hook = committed.append
+    cs.start()
+    run_until_height(nodes, 3)
+    assert cs.state.last_block_height >= 3
+    assert [b.header.height for b in committed][:3] == [1, 2, 3]
+    # app hash advances into the next header
+    assert committed[1].header.app_hash != b""
+
+
+def test_four_validators_commit_and_agree():
+    nodes, _ = make_net(4)
+    for n in nodes:
+        n.start()
+    run_until_height(nodes, 3)
+    hashes = {n.state.last_block_id.key() for n in nodes
+              if n.state.last_block_height == nodes[0].state.last_block_height}
+    assert len(hashes) == 1  # all agree on the chain tip
+    assert all(n.state.last_block_height >= 3 for n in nodes)
+
+
+def test_net_with_txs_delivers_to_all_apps():
+    nodes, keys = make_net(4)
+    apps = []
+    # rebuild with recorded apps + a simple list mempool on the proposer
+    gen = GenesisDoc(chain_id="tx-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+
+    class ListMempool:
+        def __init__(self):
+            self.txs = []
+        def lock(self): pass
+        def unlock(self): pass
+        def size(self): return len(self.txs)
+        def reap(self, mx): return self.txs[:mx]
+        def update(self, height, txs):
+            self.txs = [t for t in self.txs if t not in txs]
+        def flush(self): pass
+
+    nodes = []
+    mempools = []
+    for k in keys:
+        app = KVStoreApp()
+        apps.append(app)
+        node = make_node(gen, k, app=app)
+        mp = ListMempool()
+        node.mempool = mp
+        mempools.append(mp)
+        nodes.append(node)
+    for i, src in enumerate(nodes):
+        def relay(msg, i=i):
+            for j, dst in enumerate(nodes):
+                if j != i and msg["type"] in ("proposal", "block_part", "vote"):
+                    dst.submit(dict(msg), peer_id=f"node{i}")
+        src.broadcast_hooks.append(relay)
+
+    for mp in mempools:
+        mp.txs = [b"alpha=1", b"beta=2"]
+    for n in nodes:
+        n.start()
+    run_until_height(nodes, 2)
+    for app in apps:
+        assert app.store.get(b"alpha") == b"1"
+        assert app.store.get(b"beta") == b"2"
+    # all apps computed the same state hash
+    assert len({app.app_hash for app in apps}) == 1
+
+
+def test_validator_absent_still_commits():
+    """3 of 4 validators (75% > 2/3) should still make progress."""
+    nodes, _ = make_net(4)
+    live = nodes[:3]
+    # node 3 never starts and drops everything (its submit is disabled)
+    nodes[3].submit = lambda *a, **k: None
+    for n in live:
+        n.start()
+    run_until_height(live, 2, max_ticks=400)
+    assert all(n.state.last_block_height >= 2 for n in live)
+
+
+def test_round_advances_without_proposer():
+    """If the round-0 proposer is down, others must advance to round 1 and
+    commit with the next proposer."""
+    nodes, _ = make_net(4)
+    # find round-0 proposer of height 1 and kill it
+    proposer_addr = nodes[0].rs.validators.proposer().address
+    dead = [n for n in nodes
+            if n.priv_validator.address == proposer_addr][0]
+    live = [n for n in nodes if n is not dead]
+    dead.submit = lambda *a, **k: None
+    for n in live:
+        n.start()
+    run_until_height(live, 1, max_ticks=600)
+    assert all(n.state.last_block_height >= 1 for n in live)

@@ -1,0 +1,113 @@
+"""Crash-recovery tests: WAL catchup replay + ABCI handshake matrix
+(models consensus/replay_test.go TestHandshakeReplay* + crashingWAL)."""
+
+import os
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.consensus import ConsensusState, MockTicker
+from tendermint_tpu.consensus.replay import Handshaker, catchup_replay
+from tendermint_tpu.node import Node
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.storage import WAL, BlockStore, MemDB, StateStore
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+
+def _gen(chain_id="replay-chain"):
+    key = PrivKey.generate(b"\x09" * 32)
+    gen = GenesisDoc(chain_id=chain_id, genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    return gen, key
+
+
+def _run_node(tmp_path, gen, key, heights, in_memory=False,
+              reuse_home=True):
+    """Run an in-process single-validator node to `heights` using the real
+    Node assembly (handshake + WAL) but a mock ticker for determinism."""
+    cfg = make_test_config(str(tmp_path))
+    node = Node(cfg, gen,
+                priv_validator=PrivValidator(LocalSigner(key)),
+                app=KVStoreApp(), in_memory=in_memory)
+    # swap in a deterministic ticker before starting
+    node.consensus.ticker.stop()
+    node.consensus.ticker = MockTicker(node.consensus._on_timeout_fire)
+    node.start()
+    for _ in range(40 * heights):
+        if node.height >= heights:
+            break
+        node.consensus.ticker.fire_next()
+    assert node.height >= heights, f"stuck at {node.height}"
+    return node
+
+
+def test_node_restarts_and_continues(tmp_path):
+    gen, key = _gen()
+    node = _run_node(tmp_path, gen, key, 3)
+    h1 = node.height
+    app_hash = node.consensus.state.app_hash
+    node.stop()
+
+    # restart from disk: handshake replays the app (fresh KVStoreApp!)
+    node2 = _run_node(tmp_path, gen, key, h1 + 2)
+    assert node2.height >= h1 + 2
+    # state survived: the chain continued, not restarted
+    assert node2.consensus.state.last_block_height > h1
+    # the fresh app was replayed up to the persisted chain height
+    assert node2.app.height >= h1
+    node2.stop()
+
+
+def test_handshake_replays_all_blocks_into_fresh_app(tmp_path):
+    gen, key = _gen()
+    node = _run_node(tmp_path, gen, key, 3)
+    stored_hash = node.consensus.state.app_hash
+    state_store, block_store = node.state_store, node.block_store
+    node.stop()
+
+    fresh_app = KVStoreApp()
+    conns = AppConns(local_client_creator(fresh_app))
+    hs = Handshaker(state_store, block_store, gen)
+    state = hs.handshake(conns)
+    assert hs.n_blocks >= 3
+    assert fresh_app.height == block_store.height()
+    assert state.app_hash == stored_hash
+
+
+def test_handshake_rejects_app_ahead_of_store():
+    gen, key = _gen()
+    app = KVStoreApp()
+    app.height = 42  # pretend the app ran ahead
+    conns = AppConns(local_client_creator(app))
+    hs = Handshaker(StateStore(MemDB()), BlockStore(MemDB()), gen)
+    from tendermint_tpu.consensus.replay import HandshakeError
+    with pytest.raises(HandshakeError, match="ahead of store"):
+        hs.handshake(conns)
+
+
+def test_wal_catchup_replay_is_idempotent(tmp_path):
+    """Messages in the WAL tail re-fed after restart must not double-apply:
+    the vote sets dedup, the priv validator refuses double-signs."""
+    gen, key = _gen()
+    node = _run_node(tmp_path, gen, key, 2)
+    node.stop()
+
+    # restart; catchup_replay runs inside start()
+    cfg = make_test_config(str(tmp_path))
+    node2 = Node(cfg, gen, priv_validator=PrivValidator(LocalSigner(key)),
+                 app=KVStoreApp())
+    node2.consensus.ticker.stop()
+    node2.consensus.ticker = MockTicker(node2.consensus._on_timeout_fire)
+    h_before = node2.height
+    node2.start()  # replays tail; must not crash or regress
+    assert node2.height >= h_before
+    # chain continues after replay
+    for _ in range(80):
+        if node2.height >= h_before + 1:
+            break
+        node2.consensus.ticker.fire_next()
+    assert node2.height >= h_before + 1
+    node2.stop()
