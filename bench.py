@@ -1,0 +1,106 @@
+"""Benchmark: batched Ed25519 verification on the 10k-validator synthetic
+commit (BASELINE.json config 3 — the north-star workload replacing the
+serial loop at types/validator_set.go:240-265).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "verifies/sec", "vs_baseline": N}
+
+vs_baseline = device batch throughput / single-core scalar-CPU throughput
+(the reference's execution model: one PubKey.VerifyBytes per signature on
+the Go runtime; our scalar baseline is OpenSSL via `cryptography`, which
+is FASTER than Go's ed25519 — a conservative comparison).
+
+Run with the TPU plugin on PYTHONPATH (see .claude/skills/verify): plain
+`python bench.py` under the driver's env benches the real chip.
+"""
+
+import json
+import sys
+import time
+
+
+def scalar_baseline_rate(pubs, msgs, sigs, budget_s=3.0) -> float:
+    """Scalar verifies/sec, one at a time, OpenSSL backend (fallback: our
+    pure-python ref, scaled measurement)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey,
+        )
+
+        def verify_one(i):
+            try:
+                Ed25519PublicKey.from_public_bytes(pubs[i]).verify(
+                    sigs[i], msgs[i])
+                return True
+            except Exception:
+                return False
+    except ImportError:
+        from tendermint_tpu.utils import ed25519_ref as ref
+
+        def verify_one(i):
+            return ref.verify(pubs[i], msgs[i], sigs[i])
+
+    n_done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        assert verify_one(n_done % len(pubs))
+        n_done += 1
+    return n_done / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    import numpy as np
+    import jax
+    from tendermint_tpu.ops import ed25519
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    # deterministic synthetic 10k-validator commit
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = (i + 1).to_bytes(32, "little")
+        pk = ref.public_key(seed)
+        m = b'{"@chain_id":"bench","@type":"vote","height":1,"round":0,' + \
+            b'"idx":' + str(i).encode() + b"}"
+        pubs.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(seed, m))
+
+    pk, rb, sbits, hbits, pre = ed25519.prepare_batch(pubs, msgs, sigs)
+    assert pre.all()
+    import jax.numpy as jnp
+    args = (jnp.asarray(pk), jnp.asarray(rb),
+            jnp.asarray(sbits), jnp.asarray(hbits))
+
+    # compile + warmup
+    out = ed25519.verify_kernel_jit(*args)
+    out.block_until_ready()
+    assert bool(np.asarray(out).all()), "verification failed"
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = ed25519.verify_kernel_jit(*args)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    device_rate = n / dt
+
+    base_rate = scalar_baseline_rate(pubs, msgs, sigs)
+
+    print(json.dumps({
+        "metric": "ed25519_batch_verify_10k_commit",
+        "value": round(device_rate, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(device_rate / base_rate, 2),
+        "extra": {
+            "backend": jax.devices()[0].platform,
+            "batch": n,
+            "device_ms_per_batch": round(dt * 1e3, 2),
+            "scalar_cpu_rate": round(base_rate, 1),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Multi-chip parallelism: device meshes + sharded crypto kernels.
+
+The reference scales by adding validator nodes (SURVEY.md §2.10); inside
+one node its crypto work is serial. Here the node-local kernel plane
+scales across a TPU mesh: signature batches and Merkle leaf sets are
+sharded over the `batch` axis with shard_map, upper tree levels ride an
+all_gather over ICI.
+"""
+
+from tendermint_tpu.parallel.mesh import (
+    make_mesh, sharded_verify_kernel, sharded_merkle_root, verify_step,
+)
