@@ -1,0 +1,4 @@
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.evidence.store import EvidenceInfo, EvidenceStore
+
+__all__ = ["EvidencePool", "EvidenceInfo", "EvidenceStore"]

@@ -1,0 +1,10 @@
+from tendermint_tpu.mempool.clist import CElement, CList
+from tendermint_tpu.mempool.mempool import (
+    Mempool,
+    MempoolTx,
+    TxAlreadyInCache,
+    TxCache,
+)
+
+__all__ = ["CElement", "CList", "Mempool", "MempoolTx", "TxAlreadyInCache",
+           "TxCache"]

@@ -48,6 +48,23 @@ class Node:
         handshaker = Handshaker(self.state_store, self.block_store, gen_doc)
         state = handshaker.handshake(self.app_conns)
 
+        if mempool is None:
+            from tendermint_tpu.mempool import Mempool
+            mempool = Mempool(
+                self.app_conns.mempool, config=config.mempool,
+                height=state.last_block_height,
+                wal_dir=(None if in_memory or
+                         not getattr(config.mempool, "wal_dir", "")
+                         else config.path(config.mempool.wal_dir)))
+        self.mempool = mempool
+
+        if evidence_pool is None:
+            from tendermint_tpu.evidence import EvidencePool, EvidenceStore
+            evidence_pool = EvidencePool(
+                EvidenceStore(open_db(db_path("evidence"))), state,
+                state_store=self.state_store)
+        self.evidence_pool = evidence_pool
+
         self.event_bus = EventBus()
         block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus,
@@ -66,6 +83,9 @@ class Node:
             mempool=mempool, evidence_pool=evidence_pool,
             priv_validator=priv_validator, wal=self.wal,
             event_bus=self.event_bus, ticker_factory=TimeoutTicker)
+        if hasattr(mempool, "txs_available_hook"):
+            mempool.txs_available_hook = lambda: self.consensus.submit(
+                {"type": "txs_available"})
 
     def start(self) -> None:
         # WAL catchup for the in-flight height (consensus/replay.go:93)
@@ -77,6 +97,8 @@ class Node:
 
     def stop(self) -> None:
         self.consensus.stop()
+        if hasattr(self.mempool, "close"):
+            self.mempool.close()
         self.app_conns.close()
         if hasattr(self.wal, "close"):
             self.wal.close()

@@ -51,13 +51,13 @@ class EvidencePool(Protocol):
 
     def pending_evidence(self) -> List: ...
     def add_evidence(self, ev) -> None: ...
-    def update(self, block: Block) -> None: ...
+    def update(self, block: Block, state=None) -> None: ...
 
 
 class MockEvidencePool:
     def pending_evidence(self) -> List: return []
     def add_evidence(self, ev) -> None: ...
-    def update(self, block: Block) -> None: ...
+    def update(self, block: Block, state=None) -> None: ...
 
 
 def results_hash(results: List[ResultDeliverTx]) -> bytes:
@@ -146,7 +146,7 @@ class BlockExecutor:
         new_state.app_hash = app_hash
         if self.state_store is not None:
             self.state_store.save(new_state)
-        self.evidence_pool.update(block)
+        self.evidence_pool.update(block, new_state)
         if self.event_bus is not None:
             fire_events(self.event_bus, block, block_id, responses)
         return new_state

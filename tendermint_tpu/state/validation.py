@@ -70,9 +70,11 @@ def validate_block(state: State, block: Block, state_store=None,
 
 
 def verify_evidence(state: State, evidence, state_store=None,
-                    verifier=None) -> None:
+                    verifier=None):
     """state/validation.go:90-122: age window + the accused must have been
-    a validator at the evidence height (historical valset lookup)."""
+    a validator at the evidence height (historical valset lookup). Returns
+    the accused Validator so callers can read voting power without a
+    second valset load."""
     height = state.last_block_height + 1
     ev_height = evidence.height()
     max_age = state.consensus_params.evidence.max_age
@@ -80,8 +82,16 @@ def verify_evidence(state: State, evidence, state_store=None,
         raise BlockValidationError(
             f"evidence from height {ev_height} is too old (block {height}, "
             f"max age {max_age})")
+    if ev_height > height:
+        raise BlockValidationError(
+            f"evidence from future height {ev_height} (block {height})")
     if state_store is not None:
-        valset = state_store.load_validators(ev_height)
+        try:
+            valset = state_store.load_validators(ev_height)
+        except Exception as e:
+            raise BlockValidationError(
+                f"no validator set stored for evidence height "
+                f"{ev_height}: {e}") from e
     else:
         valset = state.validators
     _, val = valset.get_by_address(evidence.address())
@@ -89,4 +99,8 @@ def verify_evidence(state: State, evidence, state_store=None,
         raise BlockValidationError(
             f"address {evidence.address().hex()} was not a validator at "
             f"height {ev_height}")
-    evidence.verify(state.chain_id, val.pubkey, verifier=verifier)
+    try:
+        evidence.verify(state.chain_id, val.pubkey, verifier=verifier)
+    except ValueError as e:
+        raise BlockValidationError(f"invalid evidence: {e}") from e
+    return val

@@ -1,0 +1,115 @@
+"""EvidencePool + EvidenceStore tests (models evidence/pool_test.go,
+store_test.go)."""
+
+import pytest
+
+from tendermint_tpu.evidence import EvidencePool, EvidenceStore
+from tendermint_tpu.state.state import make_genesis_state
+from tendermint_tpu.state.validation import BlockValidationError
+from tendermint_tpu.storage import MemDB
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+from tendermint_tpu.types.vote import Vote, VoteType
+
+
+CHAIN = "ev-test"
+
+
+def make_state_and_keys(n=3):
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    gen = GenesisDoc(chain_id=CHAIN, genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10 + i)
+                                 for i, k in enumerate(keys)])
+    state = make_genesis_state(gen)
+    state.last_block_height = 1  # evidence must be for height >= 1
+    return state, keys
+
+
+def make_duplicate_vote_evidence(key, height=1, good=True):
+    pv = PrivValidator(LocalSigner(key))
+    bid_a = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xab" * 32))
+    bid_b = BlockID(b"\xbb" * 32, PartSetHeader(1, b"\xbc" * 32))
+    votes = []
+    for bid in (bid_a, bid_b):
+        v = Vote(pv.address, 0, height, 0, 1000, VoteType.PREVOTE, bid)
+        pv.last_height = 0  # reset double-sign guard between the two signs
+        pv.last_round = -1
+        pv.last_step = 0
+        pv.sign_vote(CHAIN, v)
+        votes.append(v)
+    ev = DuplicateVoteEvidence(key.pubkey.ed25519, votes[0], votes[1])
+    if not good:
+        ev.vote_b.signature = b"\x00" * 64
+    return ev
+
+
+def test_store_add_pending_mark_committed():
+    store = EvidenceStore(MemDB())
+    _, keys = make_state_and_keys()
+    ev = make_duplicate_vote_evidence(keys[0])
+    assert store.add_new_evidence(ev, priority=10)
+    assert not store.add_new_evidence(ev, priority=10)  # dup
+    assert store.pending_evidence() == [ev]
+    assert store.priority_evidence() == [ev]
+    assert not store.is_committed(ev)
+    store.mark_evidence_as_committed(ev)
+    assert store.pending_evidence() == []
+    assert store.priority_evidence() == []
+    assert store.is_committed(ev)
+
+
+def test_store_priority_order():
+    store = EvidenceStore(MemDB())
+    _, keys = make_state_and_keys(3)
+    evs = [make_duplicate_vote_evidence(k) for k in keys]
+    for ev, prio in zip(evs, (5, 50, 20)):
+        store.add_new_evidence(ev, prio)
+    assert store.priority_evidence() == [evs[1], evs[2], evs[0]]
+
+
+def test_pool_verifies_and_prioritizes():
+    state, keys = make_state_and_keys()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    ev = make_duplicate_vote_evidence(keys[2])  # power 12
+    pool.add_evidence(ev)
+    assert pool.pending_evidence() == [ev]
+    assert pool.drain(timeout=0.1) == ev
+
+
+def test_pool_rejects_bad_signature():
+    state, keys = make_state_and_keys()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    with pytest.raises(BlockValidationError):
+        pool.add_evidence(make_duplicate_vote_evidence(keys[0], good=False))
+    assert pool.pending_evidence() == []
+
+
+def test_pool_rejects_non_validator_and_stale():
+    state, keys = make_state_and_keys()
+    stranger = PrivKey.generate(b"\x77" * 32)
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    with pytest.raises(BlockValidationError):
+        pool.add_evidence(make_duplicate_vote_evidence(stranger))
+    # stale: beyond max_age
+    state.last_block_height = \
+        state.consensus_params.evidence.max_age + 5
+    with pytest.raises(BlockValidationError):
+        pool.add_evidence(make_duplicate_vote_evidence(keys[0], height=1))
+
+
+def test_pool_update_marks_committed_and_blocks_readd():
+    state, keys = make_state_and_keys()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    ev = make_duplicate_vote_evidence(keys[0])
+    pool.add_evidence(ev)
+
+    class FakeBlock:
+        class evidence:
+            evidence = [ev]
+
+    pool.update(FakeBlock())
+    assert pool.pending_evidence() == []
+    with pytest.raises(BlockValidationError):
+        pool.add_evidence(ev)  # already committed
