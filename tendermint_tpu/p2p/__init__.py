@@ -1,0 +1,18 @@
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn import (
+    ChannelDescriptor,
+    FlowMonitor,
+    MConnection,
+    SecretConnection,
+)
+from tendermint_tpu.p2p.key import NodeKey, pubkey_to_id, validate_id
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.peer import Peer, PeerSet
+from tendermint_tpu.p2p.switch import Switch, SwitchError
+
+__all__ = [
+    "ChannelDescriptor", "FlowMonitor", "MConnection", "NetAddress",
+    "NodeInfo", "NodeKey", "Peer", "PeerSet", "Reactor", "SecretConnection",
+    "Switch", "SwitchError", "pubkey_to_id", "validate_id",
+]

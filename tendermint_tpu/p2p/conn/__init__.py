@@ -1,0 +1,6 @@
+from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+from tendermint_tpu.p2p.conn.mconn import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.conn.secret import SecretConnection
+
+__all__ = ["ChannelDescriptor", "FlowMonitor", "MConnection",
+           "SecretConnection"]

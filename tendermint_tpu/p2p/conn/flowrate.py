@@ -1,0 +1,50 @@
+"""Flow-rate measurement + throttling (replaces tmlibs/flowrate as used by
+p2p/conn/connection.go:394 and blockchain/pool.go:122-143)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class FlowMonitor:
+    """Transfer-rate monitor with optional rate limiting.
+
+    `update(n)` records n transferred bytes and, when a limit is set,
+    sleeps just enough to keep the lifetime average at or under the limit
+    — the reference throttles its send/recv routines the same way. `rate`
+    is the lifetime average bytes/s (the eviction signal in fast-sync)."""
+
+    def __init__(self, limit_bytes_per_s: float = 0.0):
+        self.limit = limit_bytes_per_s
+        self._lock = threading.Lock()
+        self._start = time.monotonic()
+        self._total = 0
+
+    def update(self, n: int) -> None:
+        with self._lock:
+            self._total += n
+            sleep_for = 0.0
+            if self.limit > 0:
+                elapsed = time.monotonic() - self._start
+                # never ahead of limit * elapsed
+                ahead = self._total - self.limit * elapsed
+                if ahead > 0:
+                    sleep_for = ahead / self.limit
+        if sleep_for > 0:
+            time.sleep(min(sleep_for, 1.0))
+
+    @property
+    def rate(self) -> float:
+        """Current average transfer rate in bytes/s."""
+        with self._lock:
+            elapsed = time.monotonic() - self._start
+            if elapsed <= 0:
+                return 0.0
+            # long-run average is the robust signal for peer eviction
+            return self._total / elapsed
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return self._total

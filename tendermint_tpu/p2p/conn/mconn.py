@@ -1,0 +1,310 @@
+"""MConnection — N prioritized logical channels multiplexed over one link.
+
+Behavioral parity with p2p/conn/connection.go: per-channel bounded send
+queues, packetization into <=1024B frames with an EOF bit terminating each
+message, priority scheduling that always services the channel with the
+lowest recently-sent/priority ratio (:406), ping/pong keepalive (:336-359)
+and flow-rate throttling (:394, 500KB/s default per direction).
+
+The link below is anything with `write(bytes)/read()->frame/close` — a
+SecretConnection or the PlainFramedConn test adapter. One frame = one
+packet here, so AEAD frame boundaries and packet boundaries coincide.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+
+PACKET_PING = 0x01
+PACKET_PONG = 0x02
+PACKET_MSG = 0x03
+
+MAX_PACKET_PAYLOAD = 1000          # fits in a 1024B secret frame with headers
+DEFAULT_SEND_RATE = 512_000        # bytes/s (connection.go:33-35)
+DEFAULT_RECV_RATE = 512_000
+DEFAULT_SEND_QUEUE_CAPACITY = 100
+DEFAULT_RECV_MESSAGE_CAPACITY = 22_020_096  # ~21MB (connection.go:37)
+DEFAULT_PING_INTERVAL = 10.0
+DEFAULT_IDLE_TIMEOUT = 35.0
+DEFAULT_SEND_TIMEOUT = 10.0
+
+
+@dataclass
+class ChannelDescriptor:
+    """connection.go:593 ChannelDescriptor."""
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = DEFAULT_SEND_QUEUE_CAPACITY
+    recv_message_capacity: int = DEFAULT_RECV_MESSAGE_CAPACITY
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.queue: deque = deque()          # complete outgoing messages
+        self.sending: bytes = b""            # message currently packetized
+        self.sent_pos = 0
+        self.recently_sent = 0.0             # decayed byte count for priority
+        self.recv_buf: List[bytes] = []      # partial incoming message
+        self.recv_len = 0
+
+    def has_data(self) -> bool:
+        return bool(self.queue) or self.sent_pos < len(self.sending)
+
+    def next_packet(self) -> Optional[tuple]:
+        """(payload, eof) for the next packet, or None."""
+        if self.sent_pos >= len(self.sending):
+            if not self.queue:
+                return None
+            self.sending = self.queue.popleft()
+            self.sent_pos = 0
+        end = min(self.sent_pos + MAX_PACKET_PAYLOAD, len(self.sending))
+        payload = self.sending[self.sent_pos:end]
+        self.sent_pos = end
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = b""
+            self.sent_pos = 0
+        return payload, eof
+
+
+class MConnection:
+    def __init__(self, link, channel_descs: List[ChannelDescriptor],
+                 on_receive: Callable[[int, bytes], None],
+                 on_error: Callable[[Exception], None] = lambda e: None,
+                 send_rate: float = DEFAULT_SEND_RATE,
+                 recv_rate: float = DEFAULT_RECV_RATE,
+                 ping_interval: float = DEFAULT_PING_INTERVAL,
+                 idle_timeout: float = DEFAULT_IDLE_TIMEOUT):
+        self.link = link
+        self.channels: Dict[int, _Channel] = {
+            d.id: _Channel(d) for d in channel_descs}
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self.send_monitor = FlowMonitor(send_rate)
+        self.recv_monitor = FlowMonitor(recv_rate)
+        self.ping_interval = ping_interval
+        self.idle_timeout = idle_timeout
+        self._cond = threading.Condition()
+        self._pong_due = 0
+        self._stopped = False
+        self._errored = False
+        self._last_recv = time.monotonic()
+        self._threads: List[threading.Thread] = []
+
+    # ---------------------------------------------------------------- control
+
+    def start(self) -> None:
+        for fn, name in ((self._send_routine, "send"),
+                         (self._recv_routine, "recv")):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"mconn-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        try:
+            self.link.close()
+        except Exception:
+            pass
+
+    @property
+    def running(self) -> bool:
+        return not self._stopped
+
+    def _error(self, e: Exception) -> None:
+        with self._cond:
+            if self._stopped or self._errored:
+                return
+            self._errored = True
+        self.stop()
+        self.on_error(e)
+
+    # ------------------------------------------------------------------- send
+
+    def send(self, ch_id: int, msg: bytes,
+             timeout: float = DEFAULT_SEND_TIMEOUT) -> bool:
+        """Queue a full message; blocks while the channel queue is full
+        (connection.go:249). False if unknown channel/timeout/stopped."""
+        ch = self.channels.get(ch_id)
+        if ch is None or self._stopped:
+            return False
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(ch.queue) >= ch.desc.send_queue_capacity:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped:
+                    return False
+                self._cond.wait(timeout=remaining)
+            if self._stopped:
+                return False
+            ch.queue.append(bytes(msg))
+            self._cond.notify_all()
+        return True
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        """Non-blocking send (connection.go:278)."""
+        ch = self.channels.get(ch_id)
+        if ch is None or self._stopped:
+            return False
+        with self._cond:
+            if len(ch.queue) >= ch.desc.send_queue_capacity:
+                return False
+            ch.queue.append(bytes(msg))
+            self._cond.notify_all()
+        return True
+
+    def can_send(self, ch_id: int) -> bool:
+        ch = self.channels.get(ch_id)
+        if ch is None:
+            return False
+        with self._cond:
+            return len(ch.queue) < ch.desc.send_queue_capacity
+
+    def _pick_channel(self) -> Optional[_Channel]:
+        """Least recently_sent/priority among channels with data
+        (connection.go:406 sendMsgPacket)."""
+        best, best_ratio = None, None
+        for ch in self.channels.values():
+            if not ch.has_data():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        last_decay = time.monotonic()
+        try:
+            while True:
+                with self._cond:
+                    while not self._stopped and self._pong_due == 0 and \
+                            self._pick_channel() is None:
+                        now = time.monotonic()
+                        wait = max(0.05, self.ping_interval -
+                                   (now - last_ping))
+                        if now - last_ping >= self.ping_interval:
+                            break
+                        self._cond.wait(timeout=min(wait, 0.5))
+                    if self._stopped:
+                        return
+                    pongs, self._pong_due = self._pong_due, 0
+                    ch = self._pick_channel()
+                    packet = None
+                    if ch is not None:
+                        payload, eof = ch.next_packet()
+                        packet = struct.pack(
+                            ">BBB", PACKET_MSG, ch.desc.id, 1 if eof else 0
+                        ) + payload
+                        ch.recently_sent += len(payload)
+                    self._cond.notify_all()  # wake senders blocked on queue
+
+                now = time.monotonic()
+                # decay throughput stats ~every 2s (connection.go updateStats)
+                if now - last_decay >= 2.0:
+                    with self._cond:
+                        for c in self.channels.values():
+                            c.recently_sent *= 0.8
+                    last_decay = now
+                for _ in range(pongs):
+                    self.link.write(bytes([PACKET_PONG]))
+                    self.send_monitor.update(1)
+                if now - last_ping >= self.ping_interval:
+                    self.link.write(bytes([PACKET_PING]))
+                    self.send_monitor.update(1)
+                    last_ping = now
+                if packet is not None:
+                    self.link.write(packet)
+                    self.send_monitor.update(len(packet))
+                # idle/death detection
+                if now - self._last_recv > self.idle_timeout:
+                    raise ConnectionError(
+                        f"no data for {self.idle_timeout}s (keepalive)")
+        except Exception as e:
+            self._error(e)
+
+    # ------------------------------------------------------------------- recv
+
+    def _recv_routine(self) -> None:
+        try:
+            while not self._stopped:
+                frame = self.link.read()
+                if frame == b"":
+                    raise ConnectionError("connection closed by peer")
+                self.recv_monitor.update(len(frame))
+                self._last_recv = time.monotonic()
+                ptype = frame[0]
+                if ptype == PACKET_PING:
+                    with self._cond:
+                        self._pong_due += 1
+                        self._cond.notify_all()
+                elif ptype == PACKET_PONG:
+                    pass
+                elif ptype == PACKET_MSG:
+                    ch_id, eof = frame[1], frame[2]
+                    ch = self.channels.get(ch_id)
+                    if ch is None:
+                        raise ValueError(f"unknown channel {ch_id:#x}")
+                    payload = frame[3:]
+                    ch.recv_len += len(payload)
+                    if ch.recv_len > ch.desc.recv_message_capacity:
+                        raise ValueError(
+                            f"recv msg exceeds capacity on ch {ch_id:#x}")
+                    ch.recv_buf.append(payload)
+                    if eof:
+                        msg = b"".join(ch.recv_buf)
+                        ch.recv_buf = []
+                        ch.recv_len = 0
+                        self.on_receive(ch_id, msg)
+                else:
+                    raise ValueError(f"unknown packet type {ptype:#x}")
+        except Exception as e:
+            self._error(e)
+
+
+class PlainFramedConn:
+    """Unencrypted link with the same 4-byte length framing — test double
+    for SecretConnection and the fuzz wrapper's substrate."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._lock = threading.Lock()
+
+    def write(self, data: bytes) -> int:
+        with self._lock:
+            self.conn.sendall(struct.pack(">I", len(data)) + data)
+            return len(data)
+
+    def read(self) -> bytes:
+        from tendermint_tpu.p2p.conn.secret import _read_exact
+        hdr = _read_exact(self.conn, 4, allow_eof=True)
+        if hdr == b"":
+            return b""
+        (n,) = struct.unpack(">I", hdr)
+        return _read_exact(self.conn, n)
+
+    def close(self) -> None:
+        # shutdown first: close() alone neither wakes a recv() blocked in
+        # another thread nor reliably sends FIN while one is in flight
+        try:
+            self.conn.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass

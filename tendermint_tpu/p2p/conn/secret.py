@@ -1,0 +1,168 @@
+"""SecretConnection — authenticated encryption for peer links.
+
+Behavioral parity with p2p/conn/secret_connection.go (STS-like protocol):
+ephemeral DH, keys derived from the shared secret, then each side proves
+its long-term Ed25519 identity by signing the handshake challenge.
+
+TPU-era redesign of the primitives: X25519 ephemeral DH + HKDF-SHA256 key
+derivation + ChaCha20Poly1305 AEAD frames with counter nonces (the
+reference uses nacl/secretbox + SHA-256 nonce dance). Frames are
+length-prefixed ciphertexts; max plaintext per frame is 1024 bytes to
+match the reference's framing (:22).
+
+Handshake transcript:
+  1. exchange 32-byte ephemeral X25519 pubkeys (plaintext)
+  2. secret = X25519(our_eph, their_eph)
+     (k_send, k_recv, challenge) = HKDF(secret, info=sorted eph pubs)
+  3. over the now-encrypted link, exchange (node pubkey, sig(challenge))
+     and verify — the authenticated remote identity is `remote_pubkey`
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import struct
+import threading
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.hashes import SHA256
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.keys import PubKey
+
+DATA_MAX_SIZE = 1024  # plaintext bytes per frame (secret_connection.go:22)
+_TAG = 16             # poly1305 tag
+
+
+def _hkdf(secret: bytes, info: bytes, n: int) -> bytes:
+    """RFC 5869 HKDF-SHA256."""
+    return HKDF(algorithm=SHA256(), length=n, salt=None,
+                info=info).derive(secret)
+
+
+class _Cipher:
+    """One direction: ChaCha20Poly1305 with a 96-bit counter nonce."""
+
+    def __init__(self, key: bytes):
+        self.aead = ChaCha20Poly1305(key)
+        self.nonce = 0
+
+    def _next_nonce(self) -> bytes:
+        n = self.nonce
+        self.nonce += 1
+        return n.to_bytes(12, "little")
+
+    def seal(self, plaintext: bytes) -> bytes:
+        return self.aead.encrypt(self._next_nonce(), plaintext, b"")
+
+    def open(self, ciphertext: bytes) -> bytes:
+        return self.aead.decrypt(self._next_nonce(), ciphertext, b"")
+
+
+class SecretConnection:
+    """Wraps a raw socket-like conn (sendall/recv/close) with AEAD frames.
+
+    `make(conn, node_key)` performs the full handshake and returns the
+    connection with `remote_pubkey` authenticated."""
+
+    def __init__(self, conn, send_cipher: _Cipher, recv_cipher: _Cipher,
+                 remote_pubkey: bytes = b""):
+        self.conn = conn
+        self._send = send_cipher
+        self._recv = recv_cipher
+        self.remote_pubkey = remote_pubkey
+        self._send_lock = threading.Lock()
+
+    # ------------------------------------------------------------- handshake
+
+    @classmethod
+    def make(cls, conn, node_key) -> "SecretConnection":
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+        conn.sendall(eph_pub)
+        their_eph = _read_exact(conn, 32)
+
+        secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
+        lo, hi = sorted((eph_pub, their_eph))
+        keys = _hkdf(secret, b"tendermint_tpu/secret/" + lo + hi, 96)
+        k_lo, k_hi, challenge = keys[:32], keys[32:64], keys[64:]
+        if eph_pub == lo:
+            send_c, recv_c = _Cipher(k_lo), _Cipher(k_hi)
+        else:
+            send_c, recv_c = _Cipher(k_hi), _Cipher(k_lo)
+
+        sc = cls(conn, send_c, recv_c)
+
+        # authenticate over the encrypted link
+        auth = encoding.cdumps({"pubkey": node_key.pubkey.hex(),
+                                "sig": node_key.sign(challenge).hex()})
+        sc.write(auth)
+        their_auth = encoding.cloads(sc.read())
+        their_pub = bytes.fromhex(their_auth["pubkey"])
+        their_sig = bytes.fromhex(their_auth["sig"])
+        if not PubKey(their_pub).verify(challenge, their_sig):
+            conn.close()
+            raise ValueError("secret handshake: invalid identity signature")
+        sc.remote_pubkey = their_pub
+        return sc
+
+    # ----------------------------------------------------------------- frames
+
+    def write(self, data: bytes) -> int:
+        """Fragment into <=1024B plaintext frames (write in one lock so
+        concurrent writers cannot interleave nonce order)."""
+        with self._send_lock:
+            n = 0
+            view = memoryview(data)
+            while True:
+                chunk = bytes(view[:DATA_MAX_SIZE])
+                view = view[len(chunk):]
+                sealed = self._send.seal(struct.pack(">H", len(chunk)) + chunk)
+                self.conn.sendall(struct.pack(">I", len(sealed)) + sealed)
+                n += len(chunk)
+                if len(view) == 0:
+                    break
+            return n
+
+    def read(self) -> bytes:
+        """One frame's plaintext (<=1024B). b'' on clean EOF."""
+        hdr = _read_exact(self.conn, 4, allow_eof=True)
+        if hdr == b"":
+            return b""
+        (clen,) = struct.unpack(">I", hdr)
+        if clen > DATA_MAX_SIZE + 2 + _TAG:
+            raise ValueError(f"oversized secret frame: {clen}")
+        sealed = _read_exact(self.conn, clen)
+        plain = self._recv.open(sealed)
+        (dlen,) = struct.unpack(">H", plain[:2])
+        return plain[2:2 + dlen]
+
+    def close(self) -> None:
+        # shutdown wakes any recv() blocked in another thread and sends
+        # FIN immediately; bare close() does neither reliably
+        try:
+            self.conn.shutdown(_socket.SHUT_RDWR)
+        except (OSError, AttributeError):
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+def _read_exact(conn, n: int, allow_eof: bool = False) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            if allow_eof and not buf:
+                return b""
+            raise ConnectionError("unexpected EOF")
+        buf += chunk
+    return buf

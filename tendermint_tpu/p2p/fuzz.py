@@ -1,0 +1,68 @@
+"""FuzzedLink — chaos wrapper for connection links (p2p/fuzz.go).
+
+Wraps any link (write/read/close) and randomly drops writes, delays
+reads/writes, or kills the connection — the reference's FuzzedConnection
+with mode=drop (p=0.2 default) / mode=delay (:10-47). Used by tests to
+assert reactors survive a lossy transport."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class FuzzConfig:
+    """p2p/fuzz.go FuzzConnConfig defaults (:39-47)."""
+    mode: str = "drop"              # "drop" | "delay"
+    max_delay_s: float = 0.3
+    prob_drop_rw: float = 0.2
+    prob_drop_conn: float = 0.0
+    prob_sleep: float = 0.0
+    seed: int | None = None
+
+
+class FuzzedLink:
+    def __init__(self, link, config: FuzzConfig | None = None):
+        self.link = link
+        self.config = config or FuzzConfig()
+        self._rng = random.Random(self.config.seed)
+        self._lock = threading.Lock()
+        self._dead = False
+
+    def _fuzz(self) -> bool:
+        """True = drop this operation (fuzz.go:132)."""
+        cfg = self.config
+        with self._lock:
+            if self._dead:
+                raise ConnectionError("fuzzed connection killed")
+            if cfg.mode == "drop":
+                if cfg.prob_drop_conn > 0 and \
+                        self._rng.random() < cfg.prob_drop_conn:
+                    self._dead = True
+                    raise ConnectionError("fuzzed connection killed")
+                if self._rng.random() < cfg.prob_drop_rw:
+                    return True
+            elif cfg.mode == "delay":
+                if cfg.prob_sleep > 0 and self._rng.random() < cfg.prob_sleep:
+                    time.sleep(self._rng.random() * cfg.max_delay_s)
+        return False
+
+    def write(self, data: bytes) -> int:
+        if self._fuzz():
+            return len(data)  # silently dropped
+        return self.link.write(data)
+
+    def read(self) -> bytes:
+        while True:
+            frame = self.link.read()
+            if frame == b"":
+                return b""
+            if self._fuzz():
+                continue  # drop received frame
+            return frame
+
+    def close(self) -> None:
+        self.link.close()

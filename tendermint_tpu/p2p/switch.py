@@ -1,0 +1,357 @@
+"""Switch — peer lifecycle hub (p2p/switch.go).
+
+Owns the reactors, routes channels to them, accepts inbound connections,
+dials outbound ones (with reconnect + exponential backoff for persistent
+peers, :279-330), and broadcasts messages to every connected peer.
+
+The full connection path for either direction:
+  raw TCP -> SecretConnection (authenticated encryption, identity pinned)
+  -> NodeInfo exchange (version/network/channel compatibility)
+  -> Peer(MConnection) started -> reactors notified
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.p2p.conn import ChannelDescriptor, SecretConnection
+from tendermint_tpu.p2p.conn.mconn import PlainFramedConn
+from tendermint_tpu.p2p.key import NodeKey, pubkey_to_id
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.peer import (
+    Peer,
+    PeerSet,
+    read_handshake_msg,
+    write_handshake_msg,
+)
+from tendermint_tpu.types import encoding
+
+RECONNECT_ATTEMPTS = 20
+RECONNECT_BASE_S = 1.0          # exponential backoff base (switch.go:26-33)
+RECONNECT_MULTIPLIER = 2.0
+RECONNECT_MAX_S = 300.0
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch:
+    def __init__(self, config, node_key: NodeKey, node_info: NodeInfo,
+                 encrypt: bool = True):
+        self.config = config
+        self.node_key = node_key
+        self.node_info = node_info
+        self.encrypt = encrypt
+        self.reactors: Dict[str, object] = {}
+        self.channel_descs: List[ChannelDescriptor] = []
+        self.reactors_by_ch: Dict[int, object] = {}
+        self.peers = PeerSet()
+        self.dialing: set = set()
+        self.reconnecting: set = set()
+        self._listener: Optional[socket.socket] = None
+        self._listen_addr: Optional[NetAddress] = None
+        self._threads: List[threading.Thread] = []
+        self._stopped = False
+        self._lock = threading.Lock()
+        # pluggable filters (switch.go:391-416)
+        self.conn_filters: List[Callable[[NetAddress], None]] = []
+        self.id_filters: List[Callable[[str], None]] = []
+        # addr book hook (set by the PEX reactor)
+        self.addr_book = None
+
+    # ------------------------------------------------------------- reactors
+
+    def add_reactor(self, name: str, reactor) -> None:
+        """switch.go:98: register channels, reject collisions."""
+        for desc in reactor.get_channels():
+            if desc.id in self.reactors_by_ch:
+                raise SwitchError(
+                    f"channel {desc.id:#x} already registered")
+            self.channel_descs.append(desc)
+            self.reactors_by_ch[desc.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        self.node_info.channels = [d.id for d in self.channel_descs]
+
+    def reactor(self, name: str):
+        return self.reactors.get(name)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        for reactor in self.reactors.values():
+            reactor.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for peer in self.peers.list():
+            self.stop_peer_gracefully(peer)
+        for reactor in self.reactors.values():
+            reactor.stop()
+
+    # ------------------------------------------------------------- listening
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0,
+               external_host: str = "") -> NetAddress:
+        """Bind + accept loop (p2p/listener.go). Returns the ADVERTISED
+        address (with our node ID): `external_host` if given, else the
+        bind host — binding a wildcard without an external address would
+        advertise an undialable 0.0.0.0 (the reference resolves an
+        external address for the same reason, p2p/listener.go:51)."""
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((host, port))
+        ls.listen(64)
+        self._listener = ls
+        bound = ls.getsockname()
+        adv_host = external_host or getattr(
+            self.config, "external_addr", "") or bound[0]
+        if adv_host in ("0.0.0.0", "::"):
+            # best effort: a wildcard bind with no configured external
+            # address advertises the hostname's primary IP
+            try:
+                adv_host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                pass
+        self._listen_addr = NetAddress(adv_host, bound[1],
+                                       self.node_info.id)
+        self.node_info.listen_addr = f"{adv_host}:{bound[1]}"
+        t = threading.Thread(target=self._accept_routine, daemon=True,
+                             name="p2p-accept")
+        t.start()
+        self._threads.append(t)
+        return self._listen_addr
+
+    @property
+    def listen_address(self) -> Optional[NetAddress]:
+        return self._listen_addr
+
+    def _accept_routine(self) -> None:
+        while not self._stopped:
+            try:
+                sock, addrinfo = self._listener.accept()
+            except OSError:
+                return
+            if self.peers.size() >= getattr(self.config, "max_num_peers", 50):
+                sock.close()
+                continue
+            threading.Thread(
+                target=self._handle_inbound, args=(sock, addrinfo),
+                daemon=True).start()
+
+    def _handle_inbound(self, sock: socket.socket, addrinfo) -> None:
+        try:
+            self.add_peer_from_socket(sock, outbound=False,
+                                      dial_addr=None)
+        except Exception:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- dialing
+
+    def dial_peer(self, addr: NetAddress, persistent: bool = False) -> Peer:
+        """Dial + handshake + add (switch.go:460 addOutboundPeer)."""
+        with self._lock:
+            if str(addr) in self.dialing:
+                raise SwitchError(f"already dialing {addr}")
+            self.dialing.add(str(addr))
+        try:
+            for f in self.conn_filters:
+                f(addr)
+            sock = socket.create_connection(
+                addr.dial_string(),
+                timeout=getattr(self.config, "dial_timeout_s", 3.0))
+            return self.add_peer_from_socket(
+                sock, outbound=True, dial_addr=addr, persistent=persistent)
+        finally:
+            with self._lock:
+                self.dialing.discard(str(addr))
+
+    def dial_peers_async(self, addrs: List[NetAddress],
+                         persistent: bool = False) -> None:
+        """switch.go:333 DialPeersAsync: fire one dial thread per address
+        in random order."""
+        shuffled = list(addrs)
+        random.shuffle(shuffled)
+        for addr in shuffled:
+            def dial(a=addr):
+                try:
+                    self.dial_peer(a, persistent=persistent)
+                except Exception:
+                    if persistent:
+                        self._reconnect_to_peer(a)
+            threading.Thread(target=dial, daemon=True).start()
+
+    # ------------------------------------------------------------- handshake
+
+    def add_peer_from_socket(self, sock: socket.socket, outbound: bool,
+                             dial_addr: Optional[NetAddress],
+                             persistent: bool = False) -> Peer:
+        """Secret handshake + NodeInfo exchange + register (switch.go:492
+        addPeer)."""
+        link = None
+        try:
+            sock.settimeout(getattr(self.config, "handshake_timeout_s", 20.0))
+            if self.encrypt:
+                link = SecretConnection.make(sock, self.node_key)
+                remote_id = pubkey_to_id(link.remote_pubkey)
+            else:
+                link = PlainFramedConn(sock)
+                remote_id = None
+
+            write_handshake_msg(link,
+                                encoding.cdumps(self.node_info.to_obj()))
+            their_info = NodeInfo.from_obj(
+                encoding.cloads(read_handshake_msg(link)))
+            their_info.validate()
+
+            if remote_id is not None and their_info.id != remote_id:
+                raise SwitchError(
+                    f"NodeInfo.id {their_info.id} != "
+                    f"authenticated {remote_id}")
+            if dial_addr is not None and dial_addr.id and \
+                    their_info.id != dial_addr.id:
+                raise SwitchError(
+                    f"dialed {dial_addr.id} but got {their_info.id}")
+            if their_info.id == self.node_info.id:
+                raise SwitchError("self-connection rejected")
+            for f in self.id_filters:
+                f(their_info.id)
+            self.node_info.compatible_with(their_info)
+        except Exception:
+            # every handshake failure must release the socket — the dial
+            # path retries with backoff and would otherwise leak one FD
+            # per attempt
+            if link is not None:
+                link.close()
+            else:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise
+
+        sock.settimeout(None)
+        peer = Peer(
+            link, their_info, self.channel_descs, outbound=outbound,
+            persistent=persistent, dial_addr=dial_addr,
+            send_rate=getattr(self.config, "send_rate", 512_000),
+            recv_rate=getattr(self.config, "recv_rate", 512_000),
+            ping_interval=getattr(self.config, "ping_interval_s", 10.0),
+            idle_timeout=getattr(self.config, "idle_timeout_s", 35.0))
+        peer.set_handlers(self._route, self._peer_error)
+
+        if not self.peers.add(peer):
+            link.close()
+            raise SwitchError(f"duplicate peer {peer.id}")
+        peer.start()
+        for reactor in self.reactors.values():
+            try:
+                reactor.add_peer(peer)
+            except Exception:
+                pass
+        return peer
+
+    # --------------------------------------------------------------- routing
+
+    def _route(self, ch_id: int, peer: Peer, msg: bytes) -> None:
+        reactor = self.reactors_by_ch.get(ch_id)
+        if reactor is None:
+            self.stop_peer_for_error(
+                peer, ValueError(f"msg on unknown channel {ch_id:#x}"))
+            return
+        reactor.receive(ch_id, peer, msg)
+
+    def _peer_error(self, peer: Peer, err: Exception) -> None:
+        self.stop_peer_for_error(peer, err)
+
+    # ------------------------------------------------------------- stopping
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """switch.go StopPeerForError + reconnect for persistent peers."""
+        self._remove_peer(peer, reason)
+        if peer.persistent and peer.dial_addr is not None and \
+                not self._stopped:
+            threading.Thread(target=self._reconnect_to_peer,
+                             args=(peer.dial_addr,), daemon=True).start()
+
+    def stop_peer_gracefully(self, peer: Peer) -> None:
+        self._remove_peer(peer, None)
+
+    def _remove_peer(self, peer: Peer, reason) -> None:
+        if not self.peers.has(peer.id):
+            return
+        self.peers.remove(peer)
+        peer.stop()
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, reason)
+            except Exception:
+                pass
+
+    def _connected_to(self, addr: NetAddress) -> bool:
+        """Already connected to this address? Matches by ID when known,
+        else by dial/listen address — an id-less persistent peer that
+        reconnected inbound must not be redialed forever."""
+        if addr.id:
+            return self.peers.has(addr.id)
+        hostport = f"{addr.ip}:{addr.port}"
+        for p in self.peers.list():
+            if p.dial_addr is not None and \
+                    (p.dial_addr.ip, p.dial_addr.port) == (addr.ip, addr.port):
+                return True
+            if p.node_info.listen_addr == hostport:
+                return True
+        return False
+
+    def _reconnect_to_peer(self, addr: NetAddress) -> None:
+        """Exponential backoff redial (switch.go:279-330)."""
+        key = str(addr)
+        with self._lock:
+            if key in self.reconnecting:
+                return
+            self.reconnecting.add(key)
+        try:
+            for attempt in range(RECONNECT_ATTEMPTS):
+                if self._stopped or self._connected_to(addr):
+                    return
+                try:
+                    self.dial_peer(addr, persistent=True)
+                    return
+                except Exception:
+                    backoff = min(
+                        RECONNECT_MAX_S,
+                        RECONNECT_BASE_S * (RECONNECT_MULTIPLIER ** attempt))
+                    time.sleep(backoff * (0.5 + random.random() / 2))
+        finally:
+            with self._lock:
+                self.reconnecting.discard(key)
+
+    # ------------------------------------------------------------ broadcast
+
+    def broadcast(self, ch_id: int, msg: bytes) -> None:
+        """Best-effort fan-out (switch.go:210-227)."""
+        for peer in self.peers.list():
+            peer.try_send(ch_id, msg)
+
+    def broadcast_obj(self, ch_id: int, obj: dict) -> None:
+        self.broadcast(ch_id, encoding.cdumps(obj))
+
+    def num_peers(self) -> tuple:
+        """(outbound, inbound, dialing)."""
+        out = sum(1 for p in self.peers.list() if p.outbound)
+        inb = self.peers.size() - out
+        return out, inb, len(self.dialing)

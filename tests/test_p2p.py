@@ -1,0 +1,342 @@
+"""p2p stack tests: secret connection, MConnection multiplexing, switch +
+reactors (models p2p/conn/connection_test.go, secret_connection_test.go,
+switch_test.go)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.config import P2PConfig
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    MConnection,
+    NetAddress,
+    NodeKey,
+    Reactor,
+    SecretConnection,
+    SwitchError,
+    pubkey_to_id,
+)
+from tendermint_tpu.p2p.conn.mconn import PlainFramedConn
+from tendermint_tpu.p2p.test_util import (
+    connect_switches,
+    make_connected_switches,
+    make_switch,
+)
+from tendermint_tpu.types.keys import PrivKey
+
+
+def make_secret_pair():
+    s1, s2 = socket.socketpair()
+    nk1 = NodeKey(PrivKey.generate(b"\x01" * 32))
+    nk2 = NodeKey(PrivKey.generate(b"\x02" * 32))
+    out = {}
+
+    def mk(name, sock, nk):
+        out[name] = SecretConnection.make(sock, nk)
+
+    t1 = threading.Thread(target=mk, args=("a", s1, nk1))
+    t2 = threading.Thread(target=mk, args=("b", s2, nk2))
+    t1.start(); t2.start(); t1.join(10); t2.join(10)
+    return out["a"], out["b"], nk1, nk2
+
+
+# --------------------------------------------------------- SecretConnection
+
+def test_secret_connection_roundtrip_and_identity():
+    a, b, nk1, nk2 = make_secret_pair()
+    assert a.remote_pubkey == nk2.pubkey
+    assert b.remote_pubkey == nk1.pubkey
+    a.write(b"hello")
+    assert b.read() == b"hello"
+    b.write(b"world")
+    assert a.read() == b"world"
+    # large message fragments transparently
+    big = bytes(range(256)) * 20  # 5120 bytes
+    a.write(big)
+    got = b""
+    while len(got) < len(big):
+        got += b.read()
+    assert got == big
+    a.close(); b.close()
+
+
+def test_secret_connection_ciphertext_not_plaintext():
+    s1, s2 = socket.socketpair()
+    nk1 = NodeKey(PrivKey.generate(b"\x01" * 32))
+    nk2 = NodeKey(PrivKey.generate(b"\x02" * 32))
+    wire = []
+
+    class SpySocket:
+        def __init__(self, sock):
+            self._sock = sock
+
+        def sendall(self, data):
+            wire.append(bytes(data))
+            self._sock.sendall(data)
+
+        def __getattr__(self, name):
+            return getattr(self._sock, name)
+
+    spy1 = SpySocket(s1)
+    out = {}
+    t1 = threading.Thread(
+        target=lambda: out.update(a=SecretConnection.make(spy1, nk1)))
+    t2 = threading.Thread(
+        target=lambda: out.update(b=SecretConnection.make(s2, nk2)))
+    t1.start(); t2.start(); t1.join(10); t2.join(10)
+    out["a"].write(b"super-secret-payload")
+    out["b"].read()
+    assert not any(b"super-secret-payload" in w for w in wire)
+
+
+def test_secret_connection_tampering_detected():
+    a, b, _, _ = make_secret_pair()
+    # write a frame, flip ciphertext bits in transit by writing garbage
+    # directly on the raw socket with valid length framing
+    import struct
+    bad = bytes(40)
+    a.conn.sendall(struct.pack(">I", len(bad)) + bad)
+    with pytest.raises(Exception):
+        b.read()
+
+
+# -------------------------------------------------------------- MConnection
+
+def make_mconn_pair(descs1=None, descs2=None, **kw):
+    s1, s2 = socket.socketpair()
+    descs1 = descs1 or [ChannelDescriptor(0x01, priority=1)]
+    descs2 = descs2 or descs1
+    recv1, recv2 = [], []
+    errs = []
+    m1 = MConnection(PlainFramedConn(s1), descs1,
+                     on_receive=lambda ch, m: recv1.append((ch, m)),
+                     on_error=lambda e: errs.append(e), **kw)
+    m2 = MConnection(PlainFramedConn(s2), descs2,
+                     on_receive=lambda ch, m: recv2.append((ch, m)),
+                     on_error=lambda e: errs.append(e), **kw)
+    m1.start(); m2.start()
+    return m1, m2, recv1, recv2, errs
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_mconn_send_receive():
+    m1, m2, recv1, recv2, errs = make_mconn_pair()
+    assert m1.send(0x01, b"ping-message")
+    assert wait_for(lambda: recv2 == [(0x01, b"ping-message")])
+    assert m2.send(0x01, b"reply")
+    assert wait_for(lambda: recv1 == [(0x01, b"reply")])
+    m1.stop(); m2.stop()
+
+
+def test_mconn_large_message_reassembled():
+    m1, m2, _, recv2, _ = make_mconn_pair()
+    big = bytes(range(256)) * 64  # 16KB, ~17 packets
+    assert m1.send(0x01, big)
+    assert wait_for(lambda: recv2 and recv2[0][1] == big)
+    m1.stop(); m2.stop()
+
+
+def test_mconn_unknown_channel_send_fails():
+    m1, m2, *_ = make_mconn_pair()
+    assert not m1.send(0x55, b"nope")
+    m1.stop(); m2.stop()
+
+
+def test_mconn_priority_scheduling():
+    """High-priority channel data drains ahead of low-priority backlog."""
+    descs = [ChannelDescriptor(0x01, priority=1),
+             ChannelDescriptor(0x02, priority=10)]
+    m1, m2, _, recv2, _ = make_mconn_pair(descs, descs)
+    payload = bytes(900)
+    # flood the low-priority channel, then queue one high-priority msg
+    for _ in range(50):
+        m1.try_send(0x01, payload)
+    m1.send(0x02, b"urgent")
+    assert wait_for(lambda: any(ch == 0x02 for ch, _ in recv2))
+    idx_urgent = next(i for i, (ch, _) in enumerate(recv2) if ch == 0x02)
+    assert idx_urgent < 45, f"urgent message arrived at index {idx_urgent}"
+    m1.stop(); m2.stop()
+
+
+def test_mconn_peer_close_triggers_error():
+    m1, m2, _, _, errs = make_mconn_pair()
+    m2.stop()  # closes the underlying socket
+    assert wait_for(lambda: errs)
+    assert not m1.running or wait_for(lambda: not m1.running)
+    m1.stop()
+
+
+def test_mconn_ping_keeps_idle_connection_alive():
+    m1, m2, _, _, errs = make_mconn_pair(
+        ping_interval=0.1, idle_timeout=1.0)
+    time.sleep(1.5)  # > idle_timeout: only pings flow
+    assert not errs
+    assert m1.running and m2.running
+    m1.stop(); m2.stop()
+
+
+# ------------------------------------------------------------------- Switch
+
+class EchoReactor(Reactor):
+    """Echoes every message back on the same channel; records receipts."""
+
+    def __init__(self, name, ch_id, echo=True):
+        super().__init__(name)
+        self.ch_id = ch_id
+        self.echo = echo
+        self.received = []
+        self.peers_added = []
+        self.peers_removed = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(self.ch_id)]
+
+    def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    def receive(self, ch_id, peer, msg):
+        self.received.append((peer.id, msg))
+        if self.echo:
+            peer.try_send(ch_id, b"echo:" + msg)
+
+
+def test_switch_two_nodes_exchange_messages():
+    r1 = EchoReactor("echo", 0x10, echo=False)
+    r2 = EchoReactor("echo", 0x10, echo=True)
+    sw1 = make_switch(seed=b"\x01" * 32)
+    sw2 = make_switch(seed=b"\x02" * 32)
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start(); sw2.start()
+    p1, p2 = connect_switches(sw1, sw2)
+    assert r1.peers_added and r2.peers_added
+    assert p1.send(0x10, b"hello")
+    assert wait_for(lambda: r2.received)
+    assert r2.received[0][1] == b"hello"
+    assert wait_for(lambda: r1.received)
+    assert r1.received[0][1] == b"echo:hello"
+    sw1.stop(); sw2.stop()
+
+
+def test_switch_encrypted_handshake_and_routing():
+    r1 = EchoReactor("echo", 0x10, echo=False)
+    r2 = EchoReactor("echo", 0x10, echo=True)
+    sw1 = make_switch(seed=b"\x01" * 32, encrypt=True)
+    sw2 = make_switch(seed=b"\x02" * 32, encrypt=True)
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start(); sw2.start()
+    p1, _ = connect_switches(sw1, sw2)
+    # authenticated identity = NodeInfo identity
+    assert p1.id == sw2.node_info.id
+    p1.send(0x10, b"enc")
+    assert wait_for(lambda: r1.received)
+    sw1.stop(); sw2.stop()
+
+
+def test_switch_rejects_network_mismatch():
+    sw1 = make_switch(network="chain-A", seed=b"\x01" * 32)
+    sw2 = make_switch(network="chain-B", seed=b"\x02" * 32)
+    sw1.add_reactor("echo", EchoReactor("echo", 0x10))
+    sw2.add_reactor("echo", EchoReactor("echo", 0x10))
+    with pytest.raises(RuntimeError):
+        connect_switches(sw1, sw2)
+    assert sw1.peers.size() == 0 and sw2.peers.size() == 0
+
+
+def test_switch_listen_and_dial():
+    r1 = EchoReactor("echo", 0x10, echo=True)
+    r2 = EchoReactor("echo", 0x10, echo=False)
+    sw1 = make_switch(seed=b"\x01" * 32)
+    sw2 = make_switch(seed=b"\x02" * 32)
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start(); sw2.start()
+    addr = sw1.listen("127.0.0.1", 0)
+    peer = sw2.dial_peer(addr)
+    assert peer.id == sw1.node_info.id
+    assert wait_for(lambda: sw1.peers.size() == 1)
+    peer.send(0x10, b"dial-hello")
+    assert wait_for(lambda: r2.received)
+    assert r2.received[0][1] == b"echo:dial-hello"
+    sw1.stop(); sw2.stop()
+
+
+def test_switch_dial_wrong_id_rejected():
+    sw1 = make_switch(seed=b"\x01" * 32, encrypt=True)
+    sw2 = make_switch(seed=b"\x02" * 32, encrypt=True)
+    sw1.add_reactor("e", EchoReactor("e", 0x10))
+    sw2.add_reactor("e", EchoReactor("e", 0x10))
+    sw1.start(); sw2.start()
+    addr = sw1.listen("127.0.0.1", 0)
+    wrong_id = pubkey_to_id(b"\xff" * 32)
+    bad_addr = NetAddress(addr.ip, addr.port, wrong_id)
+    with pytest.raises(SwitchError):
+        sw2.dial_peer(bad_addr)
+    sw1.stop(); sw2.stop()
+
+
+def test_switch_peer_disconnect_notifies_reactors():
+    r1 = EchoReactor("echo", 0x10)
+    r2 = EchoReactor("echo", 0x10)
+    switches = make_connected_switches(
+        2, lambda i: {"echo": r1 if i == 0 else r2})
+    peer = switches[0].peers.list()[0]
+    switches[0].stop_peer_for_error(peer, RuntimeError("test"))
+    assert r1.peers_removed == [peer.id]
+    assert switches[0].peers.size() == 0
+    # the other side notices the dead connection too
+    assert wait_for(lambda: switches[1].peers.size() == 0)
+    for sw in switches:
+        sw.stop()
+
+
+def test_make_connected_switches_full_mesh():
+    n = 4
+    reactors = [EchoReactor(f"r", 0x10, echo=False) for _ in range(n)]
+    switches = make_connected_switches(n, lambda i: {"r": reactors[i]})
+    for sw in switches:
+        assert sw.peers.size() == n - 1
+    # broadcast reaches everyone
+    switches[0].broadcast(0x10, b"flood")
+    assert wait_for(
+        lambda: all(len(r.received) == 1 for r in reactors[1:]))
+    for sw in switches:
+        sw.stop()
+
+
+def test_netaddress_parse_and_classify():
+    a = NetAddress.from_string("127.0.0.1:46656")
+    assert a.local() and not a.routable()
+    b = NetAddress.from_string("8.8.8.8:26656")
+    assert b.routable() and b.valid()
+    nk = NodeKey(PrivKey.generate(b"\x05" * 32))
+    c = NetAddress.from_string(f"{nk.id()}@10.0.0.1:26656")
+    assert c.id == nk.id() and not c.routable()  # rfc1918
+    with pytest.raises(ValueError):
+        NetAddress.from_string("nohost")
+    with pytest.raises(ValueError):
+        NetAddress.from_string("zz@1.2.3.4:80")
+    assert NetAddress.from_string("10.0.1.5:80").same_group(
+        NetAddress.from_string("10.0.99.9:80"))
+
+
+def test_node_key_persistence(tmp_path):
+    path = str(tmp_path / "node_key.json")
+    nk = NodeKey.load_or_generate(path)
+    nk2 = NodeKey.load_or_generate(path)
+    assert nk.id() == nk2.id()
