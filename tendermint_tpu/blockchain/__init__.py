@@ -1,0 +1,7 @@
+from tendermint_tpu.blockchain.pool import BlockPool, BpPeer
+from tendermint_tpu.blockchain.reactor import (
+    BLOCKCHAIN_CHANNEL,
+    BlockchainReactor,
+)
+
+__all__ = ["BLOCKCHAIN_CHANNEL", "BlockPool", "BlockchainReactor", "BpPeer"]

@@ -1,0 +1,241 @@
+"""BlockPool — pipelined block downloader for fast-sync
+(blockchain/pool.go).
+
+Tracks peers and their advertised heights, keeps up to
+MAX_PENDING_REQUESTS heights in flight (each assigned to one peer),
+collects responses, and hands completed consecutive blocks to the reactor
+via `peek_two_blocks`. Slow peers (lifetime recv rate under
+MIN_RECV_RATE) and timed-out requests get their peer dropped and the
+heights reassigned (:35-42, 122-143)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+
+MAX_PENDING_REQUESTS = 1000       # blockchain/pool.go:31
+MAX_PENDING_PER_PEER = 50
+MIN_RECV_RATE = 7680              # B/s (blockchain/pool.go:35-42)
+PEER_TIMEOUT_S = 15.0
+MIN_RATE_GRACE_S = 2.0
+
+
+class BpPeer:
+    """blockchain/pool.go:369 bpPeer."""
+
+    def __init__(self, peer_id: str, height: int):
+        self.id = peer_id
+        self.height = height
+        self.num_pending = 0
+        self.recv_monitor = FlowMonitor()
+        self.first_request_at = 0.0
+
+    def on_request(self) -> None:
+        self.num_pending += 1
+        if self.first_request_at == 0.0:
+            self.first_request_at = time.monotonic()
+
+    def on_block(self, size: int) -> None:
+        self.num_pending = max(0, self.num_pending - 1)
+        self.recv_monitor.update(size)
+
+    def is_slow(self) -> bool:
+        if self.first_request_at == 0.0 or self.num_pending == 0:
+            return False
+        if time.monotonic() - self.first_request_at < MIN_RATE_GRACE_S:
+            return False
+        return self.recv_monitor.rate < MIN_RECV_RATE
+
+
+class _Request:
+    __slots__ = ("height", "peer_id", "block", "sent_at")
+
+    def __init__(self, height: int, peer_id: str):
+        self.height = height
+        self.peer_id = peer_id
+        self.block = None
+        self.sent_at = time.monotonic()
+
+
+class BlockPool:
+    def __init__(self, start_height: int,
+                 send_request: Callable[[str, int], bool],
+                 on_peer_error: Callable[[str, str], None]):
+        """send_request(peer_id, height) -> sent ok;
+        on_peer_error(peer_id, reason) drops the peer at the switch."""
+        self.height = start_height           # next height to sync
+        self.send_request = send_request
+        self.on_peer_error = on_peer_error
+        self._lock = threading.Lock()
+        self.peers: Dict[str, BpPeer] = {}
+        self.requests: Dict[int, _Request] = {}
+        self._started_at = time.monotonic()
+
+    # ----------------------------------------------------------------- peers
+
+    def set_peer_height(self, peer_id: str, height: int) -> None:
+        with self._lock:
+            p = self.peers.get(peer_id)
+            if p is None:
+                self.peers[peer_id] = BpPeer(peer_id, height)
+            else:
+                p.height = max(p.height, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self.peers.pop(peer_id, None)
+            for req in self.requests.values():
+                if req.peer_id == peer_id and req.block is None:
+                    req.peer_id = ""          # reassign on next tick
+
+    def max_peer_height(self) -> int:
+        with self._lock:
+            return max((p.height for p in self.peers.values()), default=0)
+
+    def num_peers(self) -> int:
+        with self._lock:
+            return len(self.peers)
+
+    # -------------------------------------------------------------- requests
+
+    def make_next_requests(self) -> None:
+        """Assign un-requested heights to capable peers (the reference's
+        makeRequestersRoutine + pickIncrAvailablePeer)."""
+        to_send: List[tuple] = []
+        with self._lock:
+            max_h = max((p.height for p in self.peers.values()), default=0)
+            # reassign orphaned requests (their peer vanished/timed out)
+            for req in self.requests.values():
+                if req.block is None and req.peer_id == "":
+                    peer = self._pick_peer(req.height)
+                    if peer is not None:
+                        req.peer_id = peer.id
+                        req.sent_at = time.monotonic()
+                        peer.on_request()
+                        to_send.append((peer.id, req.height))
+            next_h = self.height
+            while len(self.requests) < MAX_PENDING_REQUESTS:
+                while next_h in self.requests:
+                    next_h += 1
+                if next_h > max_h:
+                    break
+                peer = self._pick_peer(next_h)
+                if peer is None:
+                    break
+                req = _Request(next_h, peer.id)
+                self.requests[next_h] = req
+                peer.on_request()
+                to_send.append((peer.id, next_h))
+        for peer_id, h in to_send:
+            if not self.send_request(peer_id, h):
+                with self._lock:
+                    req = self.requests.get(h)
+                    if req is not None and req.peer_id == peer_id:
+                        req.peer_id = ""
+
+    def _pick_peer(self, height: int) -> Optional[BpPeer]:
+        candidates = [p for p in self.peers.values()
+                      if p.height >= height and
+                      p.num_pending < MAX_PENDING_PER_PEER]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda p: p.num_pending)
+
+    def retry_stale_requests(self) -> None:
+        """Reassign timed-out / orphaned requests; drop slow peers."""
+        drop: List[tuple] = []
+        with self._lock:
+            now = time.monotonic()
+            for p in list(self.peers.values()):
+                if p.is_slow():
+                    drop.append((p.id, "slow peer (min recv rate)"))
+            for req in self.requests.values():
+                if req.block is not None:
+                    continue
+                if req.peer_id == "" or \
+                        now - req.sent_at > PEER_TIMEOUT_S:
+                    if req.peer_id:
+                        drop.append((req.peer_id, "block request timeout"))
+                    req.peer_id = ""
+                    req.sent_at = now
+        for peer_id, reason in drop:
+            self.remove_peer(peer_id)
+            self.on_peer_error(peer_id, reason)
+        self.make_next_requests()
+
+    # --------------------------------------------------------------- blocks
+
+    def add_block(self, peer_id: str, block, size: int) -> bool:
+        """blockchain/pool.go:224 AddBlock. False = unsolicited/mismatched
+        (caller should penalize the peer)."""
+        with self._lock:
+            req = self.requests.get(block.header.height)
+            if req is None or req.block is not None:
+                return False
+            if req.peer_id and req.peer_id != peer_id:
+                return False
+            req.block = block
+            req.peer_id = peer_id
+            p = self.peers.get(peer_id)
+            if p is not None:
+                p.on_block(size)
+            return True
+
+    def peek_two_blocks(self) -> tuple:
+        """(first, second) = blocks at (height, height+1), either None
+        (blockchain/pool.go:173)."""
+        with self._lock:
+            first = self.requests.get(self.height)
+            second = self.requests.get(self.height + 1)
+            return (first.block if first else None,
+                    second.block if second else None)
+
+    def peek_window(self, k: int) -> List:
+        """Up to k+1 consecutive completed blocks starting at `height`.
+        The reactor verifies block i with block i+1's LastCommit, so a
+        returned list of n blocks yields n-1 verifiable ones. Feeds the
+        batched commit verification in the reactor."""
+        with self._lock:
+            blocks = []
+            h = self.height
+            while len(blocks) < k + 1:
+                req = self.requests.get(h)
+                if req is None or req.block is None:
+                    break
+                blocks.append(req.block)
+                h += 1
+            return blocks
+
+    def pop_request(self) -> None:
+        """Advance past a verified + applied block."""
+        with self._lock:
+            self.requests.pop(self.height, None)
+            self.height += 1
+
+    def redo_request(self, height: int) -> List[str]:
+        """Bad block: reassign this height (and its successor — the lying
+        commit may be either's) to other peers. Returns the peer ids that
+        supplied the bad data so the reactor can disconnect them."""
+        bad: List[str] = []
+        with self._lock:
+            for h in (height, height + 1):
+                req = self.requests.get(h)
+                if req is not None:
+                    if req.peer_id:
+                        bad.append(req.peer_id)
+                        self.peers.pop(req.peer_id, None)
+                    fresh = _Request(h, "")
+                    fresh.peer_id = ""
+                    self.requests[h] = fresh
+        return bad
+
+    def is_caught_up(self) -> bool:
+        """blockchain/pool.go:153 IsCaughtUp."""
+        with self._lock:
+            if not self.peers:
+                return time.monotonic() - self._started_at > 5.0
+            max_h = max(p.height for p in self.peers.values())
+            return self.height >= max_h

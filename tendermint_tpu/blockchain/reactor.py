@@ -1,0 +1,253 @@
+"""BlockchainReactor — fast-sync on channel 0x40 (blockchain/reactor.go).
+
+Downloads the chain from peers via the BlockPool, validates each block N
+against block N+1's LastCommit, stores + applies it, and hands off to the
+consensus reactor when caught up (:216-302).
+
+TPU-first redesign of the hot path: instead of one VerifyCommit per block
+(blockchain/reactor.go:286 — V signatures per block, serial), the sync
+loop drains a WINDOW of completed consecutive blocks, pools every
+signature from every window commit into ONE BatchVerifier call (one
+device dispatch), then stores/applies the verified blocks in order. With
+V validators and a window of W blocks that is one batch of V*W sigs —
+the flagship fast-sync throughput workload (BASELINE.json config 4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.block import Block, BlockID
+
+BLOCKCHAIN_CHANNEL = 0x40
+SYNC_TICK_S = 0.05                # trySyncTicker (blockchain/reactor.go)
+STATUS_UPDATE_INTERVAL_S = 10.0
+SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
+VERIFY_WINDOW = 64                # blocks batched per device dispatch
+
+
+class BlockchainReactor(Reactor):
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None, verify_window: int = VERIFY_WINDOW):
+        super().__init__("blockchain")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.verify_window = verify_window
+        self.pool = BlockPool(
+            start_height=block_store.height() + 1,
+            send_request=self._send_block_request,
+            on_peer_error=self._stop_peer)
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self.synced = not fast_sync
+        self.sync_error: Optional[Exception] = None
+
+    def get_channels(self):
+        return [ChannelDescriptor(BLOCKCHAIN_CHANNEL, priority=10,
+                                  send_queue_capacity=1000)]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self.fast_sync:
+            self._thread = threading.Thread(
+                target=self._pool_routine, daemon=True, name="fastsync")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # ----------------------------------------------------------------- peers
+
+    def add_peer(self, peer) -> None:
+        """Tell new peers our height; ask theirs (reactor.go AddPeer)."""
+        peer.try_send_obj(BLOCKCHAIN_CHANNEL, {
+            "type": "status_response", "height": self.block_store.height()})
+        peer.try_send_obj(BLOCKCHAIN_CHANNEL, {"type": "status_request"})
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    def _stop_peer(self, peer_id: str, reason: str) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, RuntimeError(reason))
+
+    def _send_block_request(self, peer_id: str, height: int) -> bool:
+        if self.switch is None:
+            return False
+        peer = self.switch.peers.get(peer_id)
+        if peer is None:
+            return False
+        return peer.try_send_obj(BLOCKCHAIN_CHANNEL, {
+            "type": "block_request", "height": height})
+
+    # -------------------------------------------------------------- receive
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        msg = encoding.cloads(msg_bytes)
+        t = msg.get("type")
+        if t == "block_request":
+            self._respond_to_block_request(peer, msg["height"])
+        elif t == "block_response":
+            block = Block.from_obj(msg["block"])
+            if not self.pool.add_block(peer.id, block, len(msg_bytes)):
+                pass  # unsolicited; ignore (reference ignores too)
+        elif t == "no_block_response":
+            pass
+        elif t == "status_request":
+            peer.try_send_obj(BLOCKCHAIN_CHANNEL, {
+                "type": "status_response",
+                "height": self.block_store.height()})
+        elif t == "status_response":
+            self.pool.set_peer_height(peer.id, msg["height"])
+        else:
+            self._stop_peer(peer.id, f"unknown blockchain msg {t!r}")
+
+    def _respond_to_block_request(self, peer, height: int) -> None:
+        """reactor.go:149 respondToPeer."""
+        block = self.block_store.load_block(height)
+        if block is None:
+            peer.try_send_obj(BLOCKCHAIN_CHANNEL, {
+                "type": "no_block_response", "height": height})
+            return
+        peer.try_send_obj(BLOCKCHAIN_CHANNEL, {
+            "type": "block_response", "block": block.to_obj()})
+
+    # ------------------------------------------------------------ sync loop
+
+    def _pool_routine(self) -> None:
+        """reactor.go:216 poolRoutine: request scheduling + SYNC_LOOP +
+        periodic status broadcasts + caught-up handoff."""
+        last_status = 0.0
+        last_switch_check = 0.0
+        while not self._stopped and self.fast_sync:
+            now = time.monotonic()
+            try:
+                self.pool.retry_stale_requests()
+                if now - last_status > STATUS_UPDATE_INTERVAL_S:
+                    self.broadcast_status_request()
+                    last_status = now
+                if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
+                    last_switch_check = now
+                    if self.pool.is_caught_up():
+                        self._switch_to_consensus()
+                        return
+                if not self._sync_window():
+                    time.sleep(SYNC_TICK_S)
+            except Exception as e:
+                # store/apply divergence is unrecoverable mid-sync (the
+                # reference panics here, consensus/state.go:1214-1220):
+                # stop LOUDLY instead of silently retrying forever
+                self.sync_error = e
+                self.fast_sync = False
+                raise
+
+    def broadcast_status_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast_obj(BLOCKCHAIN_CHANNEL,
+                                      {"type": "status_request"})
+
+    # -------------------------------------------- batched verify + apply
+
+    def _parts_and_id(self, block) -> tuple:
+        """(part_set, block_id) — built ONCE per block; part-set
+        construction (serialize + split + merkle) is the CPU cost of the
+        sync hot loop."""
+        parts = block.make_part_set(
+            self.state.consensus_params.block_gossip.block_part_size_bytes)
+        return parts, BlockID(block.hash(), parts.header())
+
+    def _sync_window(self) -> bool:
+        """Drain one window of completed blocks: ONE batched signature
+        verification for all of them, then store+apply each in order.
+
+        The batch is collected OPTIMISTICALLY against the valset at the
+        window start. If applying a block changes the validator set, the
+        precomputed results for later blocks are invalid — those fall back
+        to fresh per-block verification against the updated set (still a
+        batched verifier call per commit). Returns True on progress."""
+        blocks = self.pool.peek_window(self.verify_window)
+        if len(blocks) < 2:
+            return False
+
+        chain_id = self.state.chain_id
+        batch_valset = self.state.validators
+        batch_valset_hash = batch_valset.hash()
+
+        all_items = []
+        per_block = []  # (block, parts, block_id, commit, power|None, lo, n)
+        for i in range(len(blocks) - 1):
+            block, commit = blocks[i], blocks[i + 1].last_commit
+            parts, block_id = self._parts_and_id(block)
+            try:
+                items, item_power = batch_valset.commit_verification_items(
+                    chain_id, block_id, block.header.height, commit)
+            except ValueError:
+                # not necessarily a bad peer: the valset may change inside
+                # the window; later blocks re-verify against the updated
+                # set in the apply loop below
+                per_block.append((block, parts, block_id, commit,
+                                  None, 0, 0))
+                continue
+            per_block.append((block, parts, block_id, commit, item_power,
+                              len(all_items), len(items)))
+            all_items.extend(items)
+
+        verifier = self.block_exec.verifier
+        if verifier is None:
+            from tendermint_tpu.models.verifier import default_verifier
+            verifier = default_verifier()
+        ok = verifier.verify(all_items)  # ONE device dispatch per window
+
+        progress = False
+        for block, parts, block_id, commit, item_power, lo, n in per_block:
+            vs_now = self.state.validators
+            try:
+                if item_power is not None and \
+                        vs_now.hash() == batch_valset_hash:
+                    vs_now.check_commit_results(ok[lo:lo + n], item_power)
+                else:
+                    # valset changed mid-window (or collect failed):
+                    # verify against the set that actually signed
+                    vs_now.verify_commit(chain_id, block_id,
+                                         block.header.height, commit,
+                                         verifier=verifier)
+            except ValueError:
+                self._punish_bad_window(block.header.height)
+                return progress
+            # seen-commit = the commit FOR this block (= next block's
+            # LastCommit), matching the reference's SaveBlock(first,
+            # firstParts, second.LastCommit)
+            self.block_store.save_block(block, parts, commit)
+            # trust_last_commit: this block's own LastCommit was already
+            # batch-verified when its predecessor went through this loop
+            self.state = self.block_exec.apply_block(
+                self.state.copy(), block_id, block, trust_last_commit=True)
+            self.pool.pop_request()
+            progress = True
+        return progress
+
+    def _punish_bad_window(self, height: int) -> None:
+        for peer_id in self.pool.redo_request(height):
+            self._stop_peer(peer_id, f"bad block/commit at height {height}")
+
+    # ----------------------------------------------------------- handoff
+
+    def _switch_to_consensus(self) -> None:
+        """reactor.go:263 SwitchToConsensus."""
+        self.fast_sync = False
+        self.synced = True
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(self.state)

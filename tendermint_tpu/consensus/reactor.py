@@ -1,0 +1,413 @@
+"""ConsensusReactor — gossips the BFT state machine over p2p
+(consensus/reactor.go).
+
+Four channels: STATE (round-step + has-vote + maj23 announcements), DATA
+(proposals + block parts), VOTE, and VOTE_SET_BITS (:24-27). Each peer
+gets a PeerState mirror (:828) plus two gossip threads — data and votes
+(:137-156) — that push whatever the peer provably lacks; vote/part
+bitmaps in the PeerState prevent re-sending.
+
+Unlike the reference's goroutine/channel fabric, the state machine itself
+is the deterministic submit()-loop in ConsensusState; this reactor is
+pure I/O around it: peer messages feed cs.submit(), and the gossip
+threads read RoundState snapshots under the state machine's lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from tendermint_tpu.consensus.rstate import Step
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.types import encoding
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.vote import VoteType
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+GOSSIP_SLEEP_S = 0.02
+
+
+class PeerRoundState:
+    """What we know the peer knows (consensus/reactor.go:828 PeerState)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.height = 0
+        self.round = -1
+        self.step = 0
+        self.proposal = False
+        self.proposal_parts_total = 0
+        self.proposal_parts: set = set()      # part indices the peer has
+        self.proposal_pol_round = -1
+        self.last_commit_round = -1
+        # (height, round, type) -> set of validator indices known to peer
+        self.votes_known: Dict[tuple, set] = {}
+
+    def apply_new_round_step(self, msg: dict) -> None:
+        with self.lock:
+            prev_height, prev_round = self.height, self.round
+            self.height = msg["height"]
+            self.round = msg["round"]
+            self.step = msg["step"]
+            self.last_commit_round = msg.get("last_commit_round", -1)
+            if self.height != prev_height or self.round != prev_round:
+                self.proposal = False
+                self.proposal_parts = set()
+                self.proposal_parts_total = 0
+                self.proposal_pol_round = -1
+            if self.height != prev_height:
+                # drop stale vote knowledge for older heights
+                self.votes_known = {
+                    k: v for k, v in self.votes_known.items()
+                    if k[0] >= self.height - 1}
+
+    def set_has_vote(self, height: int, round_: int, type_: int,
+                     index: int) -> None:
+        with self.lock:
+            self.votes_known.setdefault((height, round_, type_),
+                                        set()).add(index)
+
+    def known_votes(self, height: int, round_: int, type_: int) -> set:
+        with self.lock:
+            return set(self.votes_known.get((height, round_, type_), set()))
+
+    def set_has_proposal(self, total: int) -> None:
+        with self.lock:
+            self.proposal = True
+            self.proposal_parts_total = total
+
+    def set_has_part(self, index: int) -> None:
+        with self.lock:
+            self.proposal_parts.add(index)
+
+    def snapshot(self) -> tuple:
+        with self.lock:
+            return (self.height, self.round, self.step, self.proposal,
+                    set(self.proposal_parts), self.last_commit_round)
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, consensus_state, fast_sync: bool = False,
+                 gossip_sleep_s: float = GOSSIP_SLEEP_S):
+        super().__init__("consensus")
+        self.cs = consensus_state
+        self.fast_sync = fast_sync   # gossip paused until SwitchToConsensus
+        self.gossip_sleep_s = gossip_sleep_s
+        self.peer_states: Dict[str, PeerRoundState] = {}
+        self._peer_threads: Dict[str, list] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=5,
+                              send_queue_capacity=100),
+            ChannelDescriptor(DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100),
+            ChannelDescriptor(VOTE_CHANNEL, priority=5,
+                              send_queue_capacity=100),
+            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=2),
+        ]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.cs.broadcast_hooks.append(self._on_internal_broadcast)
+        if not self.fast_sync:
+            self.cs.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.cs.stop()
+
+    def switch_to_consensus(self, state) -> None:
+        """Fast-sync complete: adopt the synced state and start the
+        machine (consensus/reactor.go:85 SwitchToConsensus). WAL catchup
+        replay runs HERE, after the state reset — the reference's
+        ConsensusState.OnStart does the same; replaying earlier would be
+        wiped by _update_to_state."""
+        from tendermint_tpu.consensus.replay import catchup_replay
+        self.cs.state = state
+        self.cs._update_to_state(state, initial=True)
+        if self.cs.state.last_block_height > 0:
+            self.cs._reconstruct_last_commit()
+        self.fast_sync = False
+        try:
+            catchup_replay(self.cs, self.cs.wal)
+        except ValueError:
+            pass  # fresh WAL, or fast-sync advanced past its last height
+        # announce ourselves: peers held back gossip while our PeerState
+        # was unknown; this round-step kicks it off
+        if self.switch is not None:
+            self.switch.broadcast_obj(STATE_CHANNEL,
+                                      self._our_round_step_msg())
+        self.cs.start()
+
+    # ----------------------------------------------------------------- peers
+
+    def add_peer(self, peer) -> None:
+        ps = PeerRoundState()
+        with self._lock:
+            self.peer_states[peer.id] = ps
+        peer.set("consensus_peer_state", ps)
+        # announce our current step so the peer can place us — but NOT
+        # while fast-syncing: advertising a height would invite vote
+        # gossip that our receive() drops while the sender marks it known
+        # (consensus/reactor.go AddPeer gates on conR.FastSync())
+        if not self.fast_sync:
+            peer.try_send_obj(STATE_CHANNEL, self._our_round_step_msg())
+        threads = []
+        for fn, name in ((self._gossip_data_routine, "data"),
+                         (self._gossip_votes_routine, "votes")):
+            t = threading.Thread(target=fn, args=(peer, ps), daemon=True,
+                                 name=f"gossip-{name}-{peer.id[:8]}")
+            t.start()
+            threads.append(t)
+        with self._lock:
+            self._peer_threads[peer.id] = threads
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._lock:
+            self.peer_states.pop(peer.id, None)
+            self._peer_threads.pop(peer.id, None)
+
+    def _our_round_step_msg(self) -> dict:
+        rs = self.cs.rs
+        return {"type": "new_round_step", "height": rs.height,
+                "round": rs.round, "step": int(rs.step),
+                "last_commit_round":
+                    rs.last_commit.round if rs.last_commit else -1}
+
+    # -------------------------------------------------------------- receive
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        msg = encoding.cloads(msg_bytes)
+        t = msg.get("type")
+        ps: Optional[PeerRoundState] = self.peer_states.get(peer.id)
+        if ps is None:
+            return
+
+        if ch_id == STATE_CHANNEL:
+            if t == "new_round_step":
+                ps.apply_new_round_step(msg)
+            elif t == "has_vote":
+                ps.set_has_vote(msg["height"], msg["round"],
+                                msg["vote_type"], msg["index"])
+            elif t == "commit_step":
+                ps.set_has_proposal(msg["parts_total"])
+            elif t == "vote_set_maj23":
+                # peer claims +2/3 for a block: record + reply with our bits
+                if self.fast_sync:
+                    return
+                bid = BlockID.from_obj(msg["block_id"])
+                bits = None
+                with self.cs._lock:
+                    rs = self.cs.rs
+                    if rs.height == msg["height"] and rs.votes is not None:
+                        rs.votes.set_peer_maj23(
+                            msg["round"], msg["vote_type"], peer.id, bid)
+                        vs = (rs.votes.prevotes(msg["round"])
+                              if msg["vote_type"] == VoteType.PREVOTE
+                              else rs.votes.precommits(msg["round"]))
+                        bits = [i for i, v in enumerate(vs.votes)
+                                if v is not None] if vs else []
+                if bits is not None:  # only answer for our current height
+                    peer.try_send_obj(VOTE_SET_BITS_CHANNEL, {
+                        "type": "vote_set_bits", "height": msg["height"],
+                        "round": msg["round"],
+                        "vote_type": msg["vote_type"],
+                        "block_id": msg["block_id"], "indices": bits})
+
+        elif ch_id == DATA_CHANNEL:
+            if self.fast_sync:
+                return
+            if t == "proposal":
+                ps.set_has_proposal(
+                    msg["proposal"]["block_parts_header"]["total"])
+                self.cs.submit({"type": "proposal",
+                                "proposal": msg["proposal"]}, peer.id)
+            elif t == "block_part":
+                ps.set_has_part(msg["part"]["index"])
+                self.cs.submit({"type": "block_part",
+                                "height": msg["height"],
+                                "round": msg.get("round", -1),
+                                "part": msg["part"]}, peer.id)
+
+        elif ch_id == VOTE_CHANNEL:
+            if self.fast_sync:
+                return
+            if t == "vote":
+                v = msg["vote"]
+                ps.set_has_vote(v["height"], v["round"], v["type"],
+                                v["validator_index"])
+                self.cs.submit({"type": "vote", "vote": v}, peer.id)
+
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if t == "vote_set_bits":
+                for i in msg.get("indices", []):
+                    ps.set_has_vote(msg["height"], msg["round"],
+                                    msg["vote_type"], i)
+
+    # ---------------------------------------------- internal event broadcast
+
+    def _on_internal_broadcast(self, msg: dict) -> None:
+        """Hook on ConsensusState._broadcast: announce step changes and
+        vote possession; data/votes flow through the gossip threads."""
+        if self.switch is None:
+            return
+        t = msg.get("type")
+        if t == "new_round_step":
+            self.switch.broadcast_obj(STATE_CHANNEL, {
+                "type": "new_round_step", "height": msg["height"],
+                "round": msg["round"], "step": msg["step"],
+                "last_commit_round": msg.get("last_commit_round", -1)})
+        elif t == "has_vote":
+            self.switch.broadcast_obj(STATE_CHANNEL, {
+                "type": "has_vote", "height": msg["height"],
+                "round": msg["round"], "vote_type": msg["vote_type"],
+                "index": msg["index"]})
+
+    # -------------------------------------------------------- gossip: data
+
+    def _peer_alive(self, peer) -> bool:
+        return (not self._stopped and peer.running and
+                peer.id in self.peer_states)
+
+    def _gossip_data_routine(self, peer, ps: PeerRoundState) -> None:
+        """consensus/reactor.go:466 gossipDataRoutine."""
+        while self._peer_alive(peer):
+            if self.fast_sync:
+                time.sleep(self.gossip_sleep_s)
+                continue
+            sent = False
+            catchup_height = 0
+            with self.cs._lock:
+                rs = self.cs.rs
+                p_height, p_round, _, p_has_proposal, p_parts, _ = \
+                    ps.snapshot()
+                proposal_msg = None
+                part_msg = None
+                if rs.height == p_height:
+                    # 1) the proposal itself
+                    if rs.proposal is not None and not p_has_proposal and \
+                            rs.proposal.round == p_round:
+                        proposal_msg = {"type": "proposal",
+                                        "proposal": rs.proposal.to_obj()}
+                    # 2) block parts the peer lacks
+                    elif rs.proposal_block_parts is not None:
+                        parts = rs.proposal_block_parts
+                        for i in range(parts.total):
+                            if i not in p_parts and \
+                                    parts.get_part(i) is not None:
+                                part_msg = {
+                                    "type": "block_part",
+                                    "height": rs.height, "round": rs.round,
+                                    "part": parts.get_part(i).to_obj()}
+                                break
+                elif 0 < p_height < rs.height:
+                    catchup_height = p_height
+            if catchup_height:
+                # catchup: serve parts of the block they're finishing —
+                # store reads stay OUTSIDE the state machine's lock (the
+                # store is independently thread-safe; holding cs._lock
+                # across db I/O would stall vote/proposal processing)
+                meta = self.cs.block_store.load_block_meta(catchup_height)
+                if meta is not None:
+                    for i in range(meta.block_id.parts.total):
+                        if i not in p_parts:
+                            part = self.cs.block_store.load_block_part(
+                                catchup_height, i)
+                            if part is None:
+                                break
+                            part_msg = {
+                                "type": "block_part",
+                                "height": catchup_height, "round": -1,
+                                "part": part.to_obj()}
+                            break
+            if proposal_msg is not None:
+                if peer.send(DATA_CHANNEL, encoding.cdumps(proposal_msg)):
+                    ps.set_has_proposal(
+                        proposal_msg["proposal"]["block_parts_header"]
+                        ["total"])
+                    sent = True
+            elif part_msg is not None:
+                if peer.send(DATA_CHANNEL, encoding.cdumps(part_msg)):
+                    ps.set_has_part(part_msg["part"]["index"])
+                    sent = True
+            if not sent:
+                time.sleep(self.gossip_sleep_s)
+
+    # -------------------------------------------------------- gossip: votes
+
+    def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
+        """consensus/reactor.go:604 gossipVotesRoutine."""
+        while self._peer_alive(peer):
+            if self.fast_sync:
+                time.sleep(self.gossip_sleep_s)
+                continue
+            vote_msg = None
+            catchup_height = 0
+            with self.cs._lock:
+                rs = self.cs.rs
+                p_height, p_round, p_step, *_ , p_last_commit_round = \
+                    (*ps.snapshot(),)
+                if p_height == rs.height and rs.votes is not None:
+                    vote_msg = self._pick_vote_for(
+                        ps, rs.votes.prevotes(p_round), rs.height, p_round,
+                        VoteType.PREVOTE) or self._pick_vote_for(
+                        ps, rs.votes.precommits(p_round), rs.height,
+                        p_round, VoteType.PRECOMMIT)
+                    if vote_msg is None and p_round >= 0 and \
+                            p_round != rs.round:
+                        # also our current round's votes (peer may be behind)
+                        vote_msg = self._pick_vote_for(
+                            ps, rs.votes.prevotes(rs.round), rs.height,
+                            rs.round, VoteType.PREVOTE) or \
+                            self._pick_vote_for(
+                                ps, rs.votes.precommits(rs.round),
+                                rs.height, rs.round, VoteType.PRECOMMIT)
+                elif p_height + 1 == rs.height and rs.last_commit is not None:
+                    # peer finishing our previous height: last-commit votes
+                    vote_msg = self._pick_vote_for(
+                        ps, rs.last_commit, p_height, rs.last_commit.round,
+                        VoteType.PRECOMMIT)
+                elif 0 < p_height < rs.height:
+                    catchup_height = p_height
+            if vote_msg is None and catchup_height:
+                # deep catchup: precommits from the stored seen commit —
+                # db read outside the state machine's lock
+                commit = self.cs.block_store.load_seen_commit(catchup_height)
+                if commit is not None:
+                    known = ps.known_votes(catchup_height, commit.round(),
+                                           VoteType.PRECOMMIT)
+                    for i, pc in enumerate(commit.precommits):
+                        if pc is not None and i not in known:
+                            vote_msg = {"type": "vote",
+                                        "vote": pc.to_obj()}
+                            break
+            if vote_msg is not None:
+                if peer.send(VOTE_CHANNEL, encoding.cdumps(vote_msg)):
+                    v = vote_msg["vote"]
+                    ps.set_has_vote(v["height"], v["round"], v["type"],
+                                    v["validator_index"])
+                continue
+            time.sleep(self.gossip_sleep_s)
+
+    def _pick_vote_for(self, ps: PeerRoundState, vote_set, height: int,
+                       round_: int, type_: int) -> Optional[dict]:
+        """First vote in `vote_set` the peer doesn't have."""
+        if vote_set is None:
+            return None
+        known = ps.known_votes(height, round_, type_)
+        for i, v in enumerate(vote_set.votes):
+            if v is not None and i not in known:
+                return {"type": "vote", "vote": v.to_obj()}
+        return None

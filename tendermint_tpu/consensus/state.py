@@ -70,6 +70,7 @@ class ConsensusState:
         self._lock = threading.RLock()
         self._queue: deque = deque()
         self._processing = False
+        self._stopped = False
 
         self.ticker = ticker_factory(self._on_timeout_fire)
 
@@ -85,6 +86,8 @@ class ConsensusState:
         finds the queue idle — the single-writer discipline of the
         reference's receiveRoutine (consensus/state.go:509-557)."""
         with self._lock:
+            if self._stopped:
+                return  # late ticker/gossip input after shutdown
             self._queue.append((msg, peer_id))
             if self._processing:
                 return
@@ -111,6 +114,8 @@ class ConsensusState:
         self._schedule_round0()
 
     def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
         self.ticker.stop()
         self.wal.flush() if hasattr(self.wal, "flush") else None
 

@@ -1,4 +1,6 @@
 from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.evidence.reactor import EVIDENCE_CHANNEL, EvidenceReactor
 from tendermint_tpu.evidence.store import EvidenceInfo, EvidenceStore
 
-__all__ = ["EvidencePool", "EvidenceInfo", "EvidenceStore"]
+__all__ = ["EVIDENCE_CHANNEL", "EvidencePool", "EvidenceInfo",
+           "EvidenceReactor", "EvidenceStore"]

@@ -1,10 +1,12 @@
 from tendermint_tpu.mempool.clist import CElement, CList
 from tendermint_tpu.mempool.mempool import (
     Mempool,
+    MempoolFull,
     MempoolTx,
     TxAlreadyInCache,
     TxCache,
 )
+from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
 
-__all__ = ["CElement", "CList", "Mempool", "MempoolTx", "TxAlreadyInCache",
-           "TxCache"]
+__all__ = ["CElement", "CList", "MEMPOOL_CHANNEL", "Mempool", "MempoolFull",
+           "MempoolReactor", "MempoolTx", "TxAlreadyInCache", "TxCache"]

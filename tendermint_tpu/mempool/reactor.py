@@ -1,0 +1,92 @@
+"""MempoolReactor — tx gossip on channel 0x30 (mempool/reactor.go).
+
+One broadcast thread per peer walks the mempool CList at its own pace,
+parking on next_wait when it reaches the tip (:104-157); received txs
+funnel into Mempool.check_tx (:82-87). Peers lagging more than one height
+behind a tx's admission height are skipped until they catch up."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from tendermint_tpu.mempool.mempool import Mempool, MempoolFull, TxAlreadyInCache
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.types import encoding
+
+MEMPOOL_CHANNEL = 0x30
+PEER_CATCHUP_SLEEP_S = 0.1  # peerCatchupSleepIntervalMS (reactor.go:24)
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: Mempool, broadcast: bool = True):
+        super().__init__("mempool")
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self._stopped = False
+        self._peer_threads: Dict[str, threading.Thread] = {}
+
+    def get_channels(self):
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def add_peer(self, peer) -> None:
+        if not self.broadcast:
+            return
+        t = threading.Thread(target=self._broadcast_tx_routine,
+                             args=(peer,), daemon=True,
+                             name=f"mempool-bcast-{peer.id[:8]}")
+        t.start()
+        self._peer_threads[peer.id] = t
+
+    def remove_peer(self, peer, reason) -> None:
+        self._peer_threads.pop(peer.id, None)
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        msg = encoding.cloads(msg_bytes)
+        if msg.get("type") != "tx":
+            if self.switch is not None:
+                self.switch.stop_peer_for_error(
+                    peer, ValueError("bad mempool message"))
+            return
+        tx = bytes.fromhex(msg["tx"])
+        try:
+            self.mempool.check_tx(tx)
+        except (TxAlreadyInCache, MempoolFull):
+            pass  # dup/overflow: normal gossip noise
+
+    def _peer_height(self, peer) -> int:
+        """Consensus PeerState height when available (reactor.go:120)."""
+        ps = peer.get("consensus_peer_state")
+        if ps is None:
+            return -1
+        return ps.height
+
+    def _broadcast_tx_routine(self, peer) -> None:
+        """mempool/reactor.go:104 broadcastTxRoutine: walk the clist."""
+        el = None
+        while not self._stopped and peer.running:
+            if el is None:
+                el = self.mempool.txs.front_wait(timeout=0.5)
+                if el is None:
+                    continue
+            mtx = el.value
+            # skip peers still catching up to the tx's admission height
+            h = self._peer_height(peer)
+            if h >= 0 and h < mtx.height - 1:
+                time.sleep(PEER_CATCHUP_SLEEP_S)
+                continue
+            if not el.removed:
+                ok = peer.send(MEMPOOL_CHANNEL, encoding.cdumps(
+                    {"type": "tx", "tx": mtx.tx.hex()}))
+                if not ok:
+                    time.sleep(PEER_CATCHUP_SLEEP_S)
+                    continue
+            nxt = el.next_wait(timeout=0.5)
+            if nxt is not None or el.removed:
+                el = nxt

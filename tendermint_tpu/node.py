@@ -1,11 +1,15 @@
-"""Node — wires stores, app conns, handshake, WAL and consensus together
-(node/node.go:121-353, single-process subset; p2p/rpc attach in later
-stages via the same hooks)."""
+"""Node — assembles stores, app conns, handshake, WAL, consensus, the
+reactor stack and the p2p switch (node/node.go:121-353).
+
+With `with_p2p=True` the node runs the full networking stack: mempool /
+evidence / blockchain (fast-sync) / consensus reactors + optional PEX on
+an encrypted switch, listening on config.p2p.laddr and dialing seeds and
+persistent peers. Without it, the node is a self-contained single-process
+validator (the in-process test/tooling mode)."""
 
 from __future__ import annotations
 
 import os
-import threading
 from typing import Optional
 
 from tendermint_tpu.abci.proxy import AppConns, local_client_creator
@@ -19,10 +23,18 @@ from tendermint_tpu.types import GenesisDoc, PrivValidatorFile
 from tendermint_tpu.types.events import EventBus
 
 
+def _parse_laddr(laddr: str) -> tuple:
+    """tcp://host:port -> (host, port)."""
+    s = laddr.split("://", 1)[-1]
+    host, _, port = s.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
 class Node:
     def __init__(self, config: Config, gen_doc: GenesisDoc,
                  priv_validator=None, app=None, client_creator=None,
-                 mempool=None, evidence_pool=None, in_memory=False):
+                 mempool=None, evidence_pool=None, in_memory=False,
+                 with_p2p=False, fast_sync=False):
         self.config = config
         self.gen_doc = gen_doc
 
@@ -66,7 +78,7 @@ class Node:
         self.evidence_pool = evidence_pool
 
         self.event_bus = EventBus()
-        block_exec = BlockExecutor(
+        self.block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus,
             mempool=mempool, evidence_pool=evidence_pool,
             event_bus=self.event_bus)
@@ -79,7 +91,7 @@ class Node:
                            light=config.consensus.wal_light)
 
         self.consensus = ConsensusState(
-            config.consensus, state, block_exec, self.block_store,
+            config.consensus, state, self.block_exec, self.block_store,
             mempool=mempool, evidence_pool=evidence_pool,
             priv_validator=priv_validator, wal=self.wal,
             event_bus=self.event_bus, ticker_factory=TimeoutTicker)
@@ -87,16 +99,99 @@ class Node:
             mempool.txs_available_hook = lambda: self.consensus.submit(
                 {"type": "txs_available"})
 
+        # ------------------------------------------------ p2p reactor stack
+        self.switch = None
+        self.fast_sync = fast_sync
+        if with_p2p:
+            self._build_p2p(state, fast_sync, in_memory)
+
+    def _build_p2p(self, state, fast_sync: bool, in_memory: bool) -> None:
+        """node/node.go:235-265: switch + reactors (+PEX)."""
+        from tendermint_tpu.blockchain import BlockchainReactor
+        from tendermint_tpu.consensus.reactor import ConsensusReactor
+        from tendermint_tpu.evidence import EvidenceReactor
+        from tendermint_tpu.mempool import MempoolReactor
+        from tendermint_tpu.p2p import NodeInfo, NodeKey, Switch
+
+        if in_memory:
+            from tendermint_tpu.types.keys import PrivKey
+            node_key = NodeKey(PrivKey.generate())
+        else:
+            node_key = NodeKey.load_or_generate(
+                self.config.path("config/node_key.json"))
+        self.node_key = node_key
+        node_info = NodeInfo(
+            pubkey=node_key.pubkey,
+            moniker=getattr(self.config.base, "moniker", "node"),
+            network=self.gen_doc.chain_id)
+        self.switch = Switch(self.config.p2p, node_key, node_info)
+
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus, fast_sync=fast_sync,
+            gossip_sleep_s=self.config.consensus.peer_gossip_sleep_ms / 1e3)
+        self.blockchain_reactor = BlockchainReactor(
+            state, self.block_exec, self.block_store, fast_sync=fast_sync,
+            consensus_reactor=self.consensus_reactor)
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, broadcast=self.config.mempool.broadcast)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("blockchain", self.blockchain_reactor)
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("evidence", self.evidence_reactor)
+
+        if self.config.p2p.pex:
+            from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
+            book_path = None if in_memory else \
+                self.config.path("config/addrbook.json")
+            self.addr_book = AddrBook(
+                path=book_path, strict=self.config.p2p.addr_book_strict)
+            self.pex_reactor = PEXReactor(
+                self.addr_book, seed_mode=self.config.p2p.seed_mode)
+            self.switch.add_reactor("pex", self.pex_reactor)
+            self.switch.addr_book = self.addr_book
+
     def start(self) -> None:
-        # WAL catchup for the in-flight height (consensus/replay.go:93)
-        try:
-            catchup_replay(self.consensus, self.wal)
-        except ValueError:
-            pass  # empty/fresh WAL
-        self.consensus.start()
+        # WAL catchup for the in-flight height (consensus/replay.go:93).
+        # In fast-sync mode the consensus reactor replays at
+        # switch_to_consensus instead — replaying now would be wiped by
+        # the post-sync state reset.
+        if not self.fast_sync:
+            try:
+                catchup_replay(self.consensus, self.wal)
+            except ValueError:
+                pass  # empty/fresh WAL
+
+        if self.switch is not None:
+            host, port = _parse_laddr(self.config.p2p.laddr)
+            self.switch.listen(host, port)
+            if hasattr(self, "addr_book"):
+                self.addr_book.add_our_address(self.switch.listen_address)
+            self.switch.start()  # starts all reactors; consensus reactor
+            #                      starts the state machine unless fast-sync
+            self._dial_configured_peers()
+        else:
+            self.consensus.start()
+
+    def _dial_configured_peers(self) -> None:
+        from tendermint_tpu.p2p import NetAddress
+        persistent = [a for a in
+                      self.config.p2p.persistent_peers.split(",") if a]
+        seeds = [a for a in self.config.p2p.seeds.split(",") if a]
+        if persistent:
+            self.switch.dial_peers_async(
+                [NetAddress.from_string(a) for a in persistent],
+                persistent=True)
+        if seeds:
+            self.switch.dial_peers_async(
+                [NetAddress.from_string(a) for a in seeds])
 
     def stop(self) -> None:
-        self.consensus.stop()
+        if self.switch is not None:
+            self.switch.stop()
+        else:
+            self.consensus.stop()
         if hasattr(self.mempool, "close"):
             self.mempool.close()
         self.app_conns.close()
@@ -108,12 +203,16 @@ class Node:
         return self.consensus.state.last_block_height
 
 
-def default_node(home: str, app=None, in_memory=False) -> Node:
+def default_node(home: str, app=None, in_memory=False,
+                 with_p2p=False, fast_sync=None) -> Node:
     """DefaultNewNode (node/node.go:79): load config tree from `home`."""
     from tendermint_tpu.config import default_config
     config = default_config(home)
     gen_doc = GenesisDoc.load(os.path.join(home, "config", "genesis.json"))
     pv = PrivValidatorFile.load_or_generate(
         os.path.join(home, "config", "priv_validator.json"))
+    if fast_sync is None:
+        fast_sync = with_p2p and getattr(config.base, "fast_sync", True)
     return Node(config, gen_doc, priv_validator=pv, app=app,
-                in_memory=in_memory)
+                in_memory=in_memory, with_p2p=with_p2p,
+                fast_sync=fast_sync)

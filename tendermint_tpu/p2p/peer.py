@@ -46,6 +46,10 @@ class Peer:
         self.outbound = outbound
         self.persistent = persistent
         self.dial_addr = dial_addr
+        # channels the REMOTE advertised: sends on others are no-ops —
+        # the receiving MConnection treats unknown channels as a protocol
+        # violation (p2p/node_info.go channel negotiation)
+        self._their_channels = set(node_info.channels)
         self._data: Dict[str, object] = {}   # reactor scratch (peer.go:226)
         self._on_receive: Callable[[int, "Peer", bytes], None] = \
             lambda ch, p, m: None
@@ -86,10 +90,17 @@ class Peer:
 
     # messaging --------------------------------------------------------------
 
+    def has_channel(self, ch_id: int) -> bool:
+        return not self._their_channels or ch_id in self._their_channels
+
     def send(self, ch_id: int, msg: bytes) -> bool:
+        if not self.has_channel(ch_id):
+            return False
         return self.mconn.send(ch_id, msg)
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
+        if not self.has_channel(ch_id):
+            return False
         return self.mconn.try_send(ch_id, msg)
 
     def send_obj(self, ch_id: int, obj: dict) -> bool:

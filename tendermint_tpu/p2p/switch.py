@@ -142,7 +142,12 @@ class Switch:
             try:
                 sock, addrinfo = self._listener.accept()
             except OSError:
-                return
+                if self._stopped:
+                    return
+                # transient (ECONNABORTED, EMFILE, ...): keep accepting —
+                # exiting here would silently stop all inbound peering
+                time.sleep(0.1)
+                continue
             if self.peers.size() >= getattr(self.config, "max_num_peers", 50):
                 sock.close()
                 continue

@@ -118,15 +118,19 @@ class BlockExecutor:
         self.event_bus = event_bus
         self.verifier = verifier
 
-    def validate_block(self, state: State, block: Block) -> None:
+    def validate_block(self, state: State, block: Block,
+                       trust_last_commit: bool = False) -> None:
         validate_block(state, block, state_store=self.state_store,
-                       verifier=self.verifier)
+                       verifier=self.verifier,
+                       trust_last_commit=trust_last_commit)
 
     def apply_block(self, state: State, block_id: BlockID,
-                    block: Block) -> State:
+                    block: Block, trust_last_commit: bool = False) -> State:
         """state/execution.go:71-119. Returns the new State; raises
-        BlockValidationError on an invalid block."""
-        self.validate_block(state, block)
+        BlockValidationError on an invalid block. `trust_last_commit`:
+        see validation.validate_block (fast-sync pre-verified path)."""
+        self.validate_block(state, block,
+                            trust_last_commit=trust_last_commit)
         responses = exec_block_on_app(self.app_conn, block, state.validators)
         if self.state_store is not None:
             self.state_store.save_abci_responses(

@@ -18,8 +18,13 @@ class BlockValidationError(Exception):
 
 
 def validate_block(state: State, block: Block, state_store=None,
-                   verifier=None) -> None:
-    """state/validation.go:15-122."""
+                   verifier=None, trust_last_commit: bool = False) -> None:
+    """state/validation.go:15-122.
+
+    trust_last_commit=True skips the LastCommit SIGNATURE check (structure
+    is still checked) — fast-sync sets it because each commit was already
+    batch-verified as block N+1's LastCommit before apply; re-verifying
+    inside apply would double every device dispatch."""
     try:
         block.validate_basic()
     except ValueError as e:
@@ -57,13 +62,15 @@ def validate_block(state: State, block: Block, state_store=None,
             raise BlockValidationError(
                 f"last_commit size {block.last_commit.size()} != "
                 f"last validators {len(state.last_validators)}")
-        try:
-            state.last_validators.verify_commit(
-                state.chain_id, state.last_block_id,
-                state.last_block_height, block.last_commit,
-                verifier=verifier)
-        except ValueError as e:
-            raise BlockValidationError(f"invalid last_commit: {e}") from e
+        if not trust_last_commit:
+            try:
+                state.last_validators.verify_commit(
+                    state.chain_id, state.last_block_id,
+                    state.last_block_height, block.last_commit,
+                    verifier=verifier)
+            except ValueError as e:
+                raise BlockValidationError(
+                    f"invalid last_commit: {e}") from e
 
     for ev in block.evidence.evidence:
         verify_evidence(state, ev, state_store, verifier=verifier)

@@ -123,17 +123,13 @@ class ValidatorSet:
 
     # -- commit verification: THE batched hot path --------------------------
 
-    def verify_commit(self, chain_id: str, block_id, height: int, commit,
-                      verifier=None) -> None:
-        """Verify that +2/3 of this set signed the commit.
-
-        Reference semantics (types/validator_set.go:229-273): size match,
-        height match, per-vote sanity, then signature verification and
-        power counting — but the signatures are verified as ONE batch.
-        Raises ValueError on failure.
-        """
-        from tendermint_tpu.models.verifier import default_verifier
-        verifier = verifier or default_verifier()
+    def commit_verification_items(self, chain_id: str, block_id,
+                                  height: int, commit):
+        """Collect phase of verify_commit: structural checks + the
+        (pubkey, sign_bytes, sig) triples with per-item power metadata.
+        Split out so fast-sync can pool items from MANY blocks into one
+        device batch (blockchain/reactor.go:286's per-block loop becomes
+        one TPU dispatch per window)."""
         if len(self.validators) != commit.size():
             raise ValueError(
                 f"commit size {commit.size()} != valset size {len(self.validators)}")
@@ -153,8 +149,11 @@ class ValidatorSet:
             val = self.validators[idx]
             items.append((val.pubkey, pc.sign_bytes(chain_id), pc.signature))
             item_power.append((val.voting_power, pc.block_id == block_id))
+        return items, item_power
 
-        ok = verifier.verify(items)
+    def check_commit_results(self, ok, item_power) -> None:
+        """Judge phase of verify_commit: every signature valid and +2/3
+        power on the block. Raises ValueError on failure."""
         power_for_block = 0
         for valid, (power, for_block) in zip(ok, item_power):
             if not valid:
@@ -166,6 +165,22 @@ class ValidatorSet:
         if not power_for_block * 3 > self.total_voting_power() * 2:
             raise ValueError(
                 f"insufficient voting power: {power_for_block}/{self.total_voting_power()}")
+
+    def verify_commit(self, chain_id: str, block_id, height: int, commit,
+                      verifier=None) -> None:
+        """Verify that +2/3 of this set signed the commit.
+
+        Reference semantics (types/validator_set.go:229-273): size match,
+        height match, per-vote sanity, then signature verification and
+        power counting — but the signatures are verified as ONE batch.
+        Raises ValueError on failure.
+        """
+        from tendermint_tpu.models.verifier import default_verifier
+        verifier = verifier or default_verifier()
+        items, item_power = self.commit_verification_items(
+            chain_id, block_id, height, commit)
+        ok = verifier.verify(items)
+        self.check_commit_results(ok, item_power)
 
     def verify_commit_any(self, new_set: "ValidatorSet", chain_id: str,
                           block_id, height: int, commit, verifier=None) -> None:

@@ -1,0 +1,71 @@
+"""Full-node p2p integration: complete Node objects (stores + WAL + app +
+mempool + evidence + all reactors + switch) forming a real TCP network —
+the assembled system node/node.go builds (§3.1)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+
+def make_net_nodes(tmp_path, n, fast_sync=False):
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    gen = GenesisDoc(chain_id="node-net", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    nodes = []
+    for i, k in enumerate(keys):
+        cfg = make_test_config(str(tmp_path / f"node{i}"))
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.addr_book_strict = False
+        node = Node(cfg, gen, priv_validator=PrivValidator(LocalSigner(k)),
+                    in_memory=True, with_p2p=True, fast_sync=fast_sync)
+        nodes.append(node)
+    return nodes
+
+
+def wait_for(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_two_full_nodes_reach_consensus_over_tcp(tmp_path):
+    nodes = make_net_nodes(tmp_path, 2)
+    try:
+        for node in nodes:
+            node.start()
+        nodes[1].switch.dial_peer(nodes[0].switch.listen_address)
+        assert wait_for(lambda: all(n.height >= 3 for n in nodes)), \
+            [n.height for n in nodes]
+        assert nodes[0].consensus.state.last_block_id == \
+            nodes[1].consensus.state.last_block_id
+    finally:
+        for node in nodes:
+            node.stop()
+
+
+def test_tx_gossips_between_full_nodes(tmp_path):
+    nodes = make_net_nodes(tmp_path, 2)
+    try:
+        for node in nodes:
+            node.start()
+        nodes[1].switch.dial_peer(nodes[0].switch.listen_address)
+        assert wait_for(lambda: all(n.height >= 1 for n in nodes))
+        # submit ONLY to node 0; the mempool reactor must carry it to the
+        # other node, and a block must deliver it to both apps
+        nodes[0].mempool.check_tx(b"gossip=works")
+        assert wait_for(
+            lambda: all(n.app.store.get(b"gossip") == b"works"
+                        for n in nodes)), \
+            [dict(n.app.store) for n in nodes]
+    finally:
+        for node in nodes:
+            node.stop()
