@@ -31,21 +31,29 @@ class BpPeer:
         self.height = height
         self.num_pending = 0
         self.recv_monitor = FlowMonitor()
-        self.first_request_at = 0.0
+        self.burst_started_at = 0.0
 
     def on_request(self) -> None:
+        if self.num_pending == 0:
+            # measure rate per request burst, not per peer lifetime —
+            # idle gaps must not dilute the average into an eviction
+            # (the reference resets its timeout the same way,
+            # blockchain/pool.go resetMonitor/resetTimeout)
+            self.recv_monitor = FlowMonitor()
+            self.burst_started_at = time.monotonic()
         self.num_pending += 1
-        if self.first_request_at == 0.0:
-            self.first_request_at = time.monotonic()
+
+    def on_request_failed(self) -> None:
+        self.num_pending = max(0, self.num_pending - 1)
 
     def on_block(self, size: int) -> None:
         self.num_pending = max(0, self.num_pending - 1)
         self.recv_monitor.update(size)
 
     def is_slow(self) -> bool:
-        if self.first_request_at == 0.0 or self.num_pending == 0:
+        if self.num_pending == 0:
             return False
-        if time.monotonic() - self.first_request_at < MIN_RATE_GRACE_S:
+        if time.monotonic() - self.burst_started_at < MIN_RATE_GRACE_S:
             return False
         return self.recv_monitor.rate < MIN_RECV_RATE
 
@@ -135,6 +143,9 @@ class BlockPool:
                     req = self.requests.get(h)
                     if req is not None and req.peer_id == peer_id:
                         req.peer_id = ""
+                    p = self.peers.get(peer_id)
+                    if p is not None:
+                        p.on_request_failed()  # drain the phantom pending
 
     def _pick_peer(self, height: int) -> Optional[BpPeer]:
         candidates = [p for p in self.peers.values()

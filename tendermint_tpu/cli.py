@@ -36,12 +36,27 @@ def cmd_init(args) -> int:
 
 
 def cmd_node(args) -> int:
-    """Run a (single-process) node committing blocks (cmd run_node.go)."""
+    """Run a node (cmd run_node.go). With --p2p it listens, dials
+    configured peers and serves RPC; otherwise it is a self-contained
+    single-process validator."""
     from tendermint_tpu.node import default_node
     from tendermint_tpu.abci.apps import CounterApp, KVStoreApp
     app = {"kvstore": KVStoreApp, "counter": CounterApp}[args.app]()
-    node = default_node(args.home, app=app)
+    node = default_node(args.home, app=app, with_p2p=args.p2p,
+                        fast_sync=(args.fast_sync if args.p2p else False))
+    if args.p2p_laddr:
+        node.config.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        node.config.rpc.laddr = args.rpc_laddr
+        node.with_rpc = True
+    if args.persistent_peers:
+        node.config.p2p.persistent_peers = args.persistent_peers
     node.start()
+    if node.switch is not None:
+        print(f"p2p listening on {node.switch.listen_address}", flush=True)
+    if node.rpc_address is not None:
+        print(f"rpc listening on {node.rpc_address[0]}:"
+              f"{node.rpc_address[1]}", flush=True)
     print(f"node started: chain={node.gen_doc.chain_id} "
           f"height={node.height}", flush=True)
     try:
@@ -100,9 +115,146 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_lite(args) -> int:
+    """Light-client proxy daemon (cmd lite.go:60): serve a local RPC
+    whose results are certified against the chain before returning."""
+    from tendermint_tpu.lite import (
+        HTTPProvider, InquiringCertifier, MemProvider, SecureClient,
+        CacheProvider, FileProvider)
+    from tendermint_tpu.rpc import JSONRPCClient, RPCServer
+
+    rpc = JSONRPCClient(args.node_addr)
+    source = HTTPProvider(rpc)
+    trusted = source.get_by_height(args.trust_height) \
+        if args.trust_height else source.latest_commit()
+    if trusted is None:
+        print("cannot fetch a trusted commit from the node")
+        return 1
+    store = CacheProvider(
+        MemProvider(), FileProvider(os.path.join(args.home, "lite")))
+    chain_id = args.chain_id or \
+        rpc.call("genesis")["genesis"]["chain_id"]
+    cert = InquiringCertifier(chain_id, trusted, store)
+    sc = SecureClient(rpc, cert)
+
+    server = RPCServer()
+    server.register("block", lambda height=0: sc.block(int(height)))
+    server.register("commit", lambda height=0: sc.commit(int(height)))
+    server.register("validators",
+                    lambda height=0: sc.validators(int(height)))
+    server.register("status", sc.status)
+    server.register("tx", lambda hash=b"", prove=True: sc.tx(hash))
+    # unverifiable routes proxied straight through
+    for route in ("broadcast_tx_sync", "broadcast_tx_async",
+                  "broadcast_tx_commit", "abci_info", "net_info",
+                  "genesis"):
+        server.register(route,
+                        (lambda r: lambda **kw: rpc.call(r, **kw))(route))
+    from tendermint_tpu.node import _parse_laddr
+    host, port = server.serve(*_parse_laddr(args.laddr))
+    print(f"lite proxy serving on {host}:{port} "
+          f"(trusting height {cert.last_height})", flush=True)
+    deadline = time.time() + args.max_seconds if args.max_seconds else None
+    try:
+        while deadline is None or time.time() < deadline:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     from tendermint_tpu import __version__
     print(__version__)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from tendermint_tpu.p2p import NodeKey
+    nk = NodeKey.load_or_generate(
+        os.path.join(args.home, "config", "node_key.json"))
+    print(nk.id())
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Emit an N-validator testnet file tree (cmd testnet.go:97): a shared
+    genesis listing every validator, per-node priv_validator + node_key +
+    config.json with persistent_peers wired to all other nodes."""
+    from tendermint_tpu.config import default_config, save_config
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.types import GenesisDoc, PrivValidatorFile
+    from tendermint_tpu.types.genesis import GenesisValidator
+
+    n = args.n
+    out = args.output or args.home
+    chain_id = args.chain_id or f"testnet-{int(time.time())}"
+    pvs, node_keys = [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg_dir = os.path.join(home, "config")
+        os.makedirs(cfg_dir, exist_ok=True)
+        pvs.append(PrivValidatorFile.load_or_generate(
+            os.path.join(cfg_dir, "priv_validator.json")))
+        node_keys.append(NodeKey.load_or_generate(
+            os.path.join(cfg_dir, "node_key.json")))
+    gen = GenesisDoc(
+        chain_id=chain_id, genesis_time_ns=time.time_ns(),
+        validators=[GenesisValidator(pv.pubkey.ed25519, 10) for pv in pvs])
+    base_port = args.base_port
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        gen.save(os.path.join(home, "config", "genesis.json"))
+        cfg = default_config(home)
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_port + 2 * i + 1}"
+        cfg.p2p.addr_book_strict = False
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_keys[j].id()}@127.0.0.1:{base_port + 2 * j}"
+            for j in range(n) if j != i)
+        save_config(cfg)
+    print(f"wrote {n}-node testnet (chain {chain_id}) under {out}")
+    return 0
+
+
+def cmd_replay(args, console: bool = False) -> int:
+    """Step through the consensus WAL against a fresh state machine
+    (consensus/replay_file.go:32 RunReplayFile). --console pauses for
+    ENTER between messages and accepts 'quit'."""
+    from tendermint_tpu.config import default_config
+    from tendermint_tpu.consensus.replay import catchup_replay
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types import GenesisDoc
+
+    config = default_config(args.home)
+    gen_doc = GenesisDoc.load(
+        os.path.join(args.home, "config", "genesis.json"))
+    node = Node(config, gen_doc, priv_validator=None)
+    cs, wal = node.consensus, node.wal
+    height = cs.state.last_block_height
+    tail = wal.messages_after_end_height(height)
+    if tail is None:
+        print(f"WAL has no messages after height {height}")
+        return 1
+    cs.replay_mode = True
+    n = 0
+    for m in tail:
+        msg = dict(m.msg)
+        peer = msg.pop("peer", "")
+        if msg.get("type") in ("round_state", "endheight"):
+            continue
+        if console:
+            cmdline = input(
+                f"> next: {msg.get('type')} (ENTER to apply, q to quit) ")
+            if cmdline.strip().lower() in ("q", "quit"):
+                break
+        cs.submit(msg, peer_id=peer)
+        n += 1
+        print(f"replayed {msg.get('type')} -> "
+              f"H/R/S {cs.rs.height}/{cs.rs.round}/{int(cs.rs.step)}")
+    print(f"replayed {n} messages; final height {cs.rs.height}")
+    node.stop()
     return 0
 
 
@@ -120,10 +272,44 @@ def main(argv=None) -> int:
                     choices=["kvstore", "counter"])
     sp.add_argument("--max-height", type=int, default=0)
     sp.add_argument("--max-seconds", type=float, default=0)
+    sp.add_argument("--p2p", action="store_true",
+                    help="run the networking stack")
+    sp.add_argument("--no-fast-sync", dest="fast_sync",
+                    action="store_false", default=True)
+    sp.add_argument("--p2p-laddr", default="",
+                    help="override p2p listen address")
+    sp.add_argument("--rpc-laddr", default="",
+                    help="serve RPC on this address")
+    sp.add_argument("--persistent-peers", default="",
+                    help="comma-separated id@host:port")
     sp.set_defaults(fn=cmd_node)
+
+    sp = sub.add_parser("testnet",
+                        help="write an N-validator testnet file tree")
+    sp.add_argument("--n", type=int, default=4)
+    sp.add_argument("--output", default="")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--base-port", type=int, default=46656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("replay", help="replay the consensus WAL")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("replay_console",
+                        help="interactively replay the consensus WAL")
+    sp.set_defaults(fn=lambda a: cmd_replay(a, console=True))
+
+    sp = sub.add_parser("lite", help="light-client RPC proxy")
+    sp.add_argument("--node-addr", default="http://127.0.0.1:46657")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--trust-height", type=int, default=0)
+    sp.add_argument("--max-seconds", type=float, default=0)
+    sp.set_defaults(fn=cmd_lite)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
     sub.add_parser("show_validator").set_defaults(fn=cmd_show_validator)
+    sub.add_parser("show_node_id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("gen_validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("unsafe_reset_all").set_defaults(fn=cmd_unsafe_reset_all)
 

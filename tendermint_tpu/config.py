@@ -113,7 +113,36 @@ class Config:
 
 
 def default_config(home: str = "") -> Config:
-    return Config(home=home)
+    """Defaults, overlaid with `<home>/config/config.json` when present
+    (the reference loads $TMHOME/config.toml via viper, config/toml.go)."""
+    cfg = Config(home=home)
+    path = os.path.join(home, "config", "config.json") if home else ""
+    if path and os.path.exists(path):
+        import json
+        with open(path) as f:
+            overrides = json.load(f)
+        for section, values in overrides.items():
+            target = getattr(cfg, section, None)
+            if target is None or not isinstance(values, dict):
+                continue
+            for k, v in values.items():
+                if hasattr(target, k):
+                    setattr(target, k, v)
+    return cfg
+
+
+def save_config(cfg: Config) -> str:
+    """Persist the non-default sections as config/config.json."""
+    import json
+    from dataclasses import asdict
+    path = os.path.join(cfg.home, "config", "config.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    obj = {name: asdict(getattr(cfg, name))
+           for name in ("base", "rpc", "p2p", "mempool", "consensus",
+                        "tx_index")}
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    return path
 
 
 def test_config(home: str = "") -> Config:

@@ -207,6 +207,9 @@ class ConsensusReactor(Reactor):
                 # peer claims +2/3 for a block: record + reply with our bits
                 if self.fast_sync:
                     return
+                if msg.get("vote_type") not in (VoteType.PREVOTE,
+                                                VoteType.PRECOMMIT):
+                    return  # malformed: ignore rather than KeyError-drop
                 bid = BlockID.from_obj(msg["block_id"])
                 bits = None
                 with self.cs._lock:

@@ -1,0 +1,25 @@
+from tendermint_tpu.lite.certifier import (
+    DynamicCertifier,
+    InquiringCertifier,
+    StaticCertifier,
+    certify_chain,
+)
+from tendermint_tpu.lite.provider import (
+    CacheProvider,
+    FileProvider,
+    HTTPProvider,
+    MemProvider,
+)
+from tendermint_tpu.lite.proxy import SecureClient
+from tendermint_tpu.lite.types import (
+    CertificationError,
+    FullCommit,
+    SignedHeader,
+    ValidatorsChangedError,
+)
+
+__all__ = ["CacheProvider", "CertificationError", "DynamicCertifier",
+           "FileProvider", "FullCommit", "HTTPProvider",
+           "InquiringCertifier", "MemProvider", "SecureClient",
+           "SignedHeader", "StaticCertifier", "ValidatorsChangedError",
+           "certify_chain"]

@@ -1,0 +1,189 @@
+"""Certifiers — the light-client trust ladder (lite/).
+
+StaticCertifier   — fixed valset, certify one height
+                    (lite/static_certifier.go:22,57)
+DynamicCertifier  — follows valset changes via verify_commit_any
+                    (lite/dynamic_certifier.go:20,70)
+InquiringCertifier— auto-updates through a Provider with BISECTION over
+                    heights when the valset moved more than +1/3 at once
+                    (lite/inquiring_certifier.go:15,67,137-163)
+
+certify_chain     — the TPU batch path: certify a whole run of
+                    consecutive FullCommits with ONE pooled signature
+                    dispatch (BASELINE.json config 5's workload).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tendermint_tpu.lite.types import (
+    CertificationError,
+    FullCommit,
+    SignedHeader,
+    ValidatorsChangedError,
+)
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class StaticCertifier:
+    """Trusts exactly one validator set forever."""
+
+    def __init__(self, chain_id: str, validators: ValidatorSet,
+                 verifier=None):
+        self.chain_id = chain_id
+        self.validators = validators
+        self.verifier = verifier
+
+    def certify(self, fc: FullCommit) -> None:
+        fc.validate_basic(self.chain_id)
+        if fc.validators.hash() != self.validators.hash():
+            raise ValidatorsChangedError(
+                "signed by a different validator set")
+        sh = fc.signed_header
+        try:
+            self.validators.verify_commit(
+                self.chain_id, sh.block_id, sh.height, sh.commit,
+                verifier=self.verifier)
+        except ValueError as e:
+            raise CertificationError(str(e)) from e
+
+
+class DynamicCertifier:
+    """Static + `update`: accept a new valset when +2/3 of it signed AND
+    +1/3 of the currently-trusted set signed (verify_commit_any)."""
+
+    def __init__(self, chain_id: str, validators: ValidatorSet,
+                 height: int = 0, verifier=None):
+        self.chain_id = chain_id
+        self.validators = validators
+        self.last_height = height
+        self.verifier = verifier
+
+    def certify(self, fc: FullCommit) -> None:
+        fc.validate_basic(self.chain_id)
+        if fc.validators.hash() != self.validators.hash():
+            raise ValidatorsChangedError(
+                "validator set changed; call update() through "
+                "intermediate commits")
+        StaticCertifier(self.chain_id, self.validators,
+                        self.verifier).certify(fc)
+
+    def update(self, fc: FullCommit) -> None:
+        """lite/dynamic_certifier.go:70 Update."""
+        fc.validate_basic(self.chain_id)
+        if fc.height <= self.last_height:
+            raise CertificationError(
+                f"update height {fc.height} <= trusted {self.last_height}")
+        sh = fc.signed_header
+        try:
+            self.validators.verify_commit_any(
+                fc.validators, self.chain_id, sh.block_id, sh.height,
+                sh.commit, verifier=self.verifier)
+        except ValueError as e:
+            raise CertificationError(str(e)) from e
+        self.validators = fc.validators
+        self.last_height = fc.height
+
+
+class InquiringCertifier:
+    """DynamicCertifier + a Provider to fetch missing FullCommits,
+    bisecting when a direct update is rejected
+    (lite/inquiring_certifier.go:137-163)."""
+
+    def __init__(self, chain_id: str, trusted: FullCommit, provider,
+                 verifier=None):
+        self.chain_id = chain_id
+        self.provider = provider
+        self.cert = DynamicCertifier(chain_id, trusted.validators,
+                                     trusted.height, verifier=verifier)
+        provider.store_commit(trusted)
+
+    @property
+    def last_height(self) -> int:
+        return self.cert.last_height
+
+    def certify(self, fc: FullCommit) -> None:
+        if fc.validators.hash() != self.cert.validators.hash():
+            self._update_to_hash_or_height(fc)
+        self.cert.certify(fc)
+        self.provider.store_commit(fc)
+
+    def _update_to_hash_or_height(self, fc: FullCommit) -> None:
+        """Walk trust from last_height to fc.height via update(); on an
+        'insufficient old-set power' rejection, bisect the height range
+        and trust the midpoint first."""
+        self._update_to(fc, depth=0)
+
+    def _update_to(self, fc: FullCommit, depth: int) -> None:
+        if depth > 64:
+            raise CertificationError("bisection too deep")
+        try:
+            self.cert.update(fc)
+            self.provider.store_commit(fc)
+            return
+        except CertificationError:
+            pass
+        lo, hi = self.cert.last_height, fc.height
+        if hi - lo <= 1:
+            raise CertificationError(
+                f"cannot bridge trust from {lo} to {hi}")
+        mid_h = (lo + hi) // 2
+        mid = self.provider.get_by_height(mid_h)
+        if mid is None:
+            raise CertificationError(f"provider has no commit <= {mid_h}")
+        if mid.height <= lo:
+            raise CertificationError(
+                f"cannot bridge trust: no commits in ({lo}, {mid_h}]")
+        self._update_to(mid, depth + 1)
+        self._update_to(fc, depth + 1)
+
+
+def certify_chain(chain_id: str, fcs: List[FullCommit],
+                  trusted: Optional[ValidatorSet] = None,
+                  verifier=None) -> None:
+    """Certify consecutive FullCommits with ONE pooled signature batch.
+
+    Structural checks + valset-continuity run on host per header; every
+    commit signature across the whole chain goes to the device in a
+    single BatchVerifier call — the 1M-header lite-chain workload
+    (BASELINE.json config 5) instead of per-header VerifyCommit loops
+    (lite/performance_test.go's shape).
+
+    `trusted`: valset required to have signed fcs[0] (defaults to
+    fcs[0].validators — self-certifying chain head). Raises
+    CertificationError on the first bad header."""
+    from tendermint_tpu.models.verifier import default_verifier
+    verifier = verifier or default_verifier()
+    if not fcs:
+        return
+
+    all_items = []
+    spans = []  # (valset, item_power, lo, n, height)
+    expect_vals = trusted or fcs[0].validators
+    for fc in fcs:
+        fc.validate_basic(chain_id)
+        if fc.validators.hash() != expect_vals.hash():
+            raise ValidatorsChangedError(
+                f"valset discontinuity at height {fc.height}")
+        sh = fc.signed_header
+        try:
+            items, item_power = expect_vals.commit_verification_items(
+                chain_id, sh.block_id, sh.height, sh.commit)
+        except ValueError as e:
+            raise CertificationError(
+                f"height {fc.height}: {e}") from e
+        spans.append((expect_vals, item_power, len(all_items),
+                      len(items), fc.height))
+        all_items.extend(items)
+        # constant-valset segments only: when the set changes, the caller
+        # splits the chain there and bridges with DynamicCertifier.update
+        # (that transition needs verify_commit_any, which can't pool
+        # across the boundary)
+
+    ok = verifier.verify(all_items)  # ONE device dispatch
+    for valset, item_power, lo, n, height in spans:
+        try:
+            valset.check_commit_results(ok[lo:lo + n], item_power)
+        except ValueError as e:
+            raise CertificationError(f"height {height}: {e}") from e

@@ -1,0 +1,76 @@
+"""SecureClient — proof-checking RPC proxy (lite/proxy/wrapper.go:25).
+
+Wraps an RPC client so results are verified against certified headers
+before being returned: blocks must hash to a certified header, commits
+are certified, abci_query results are checked against the proven app
+state where possible."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tendermint_tpu.lite.certifier import InquiringCertifier
+from tendermint_tpu.lite.provider import HTTPProvider
+from tendermint_tpu.lite.types import CertificationError, FullCommit
+from tendermint_tpu.types.block import Block
+
+
+class SecureClient:
+    def __init__(self, rpc_client, certifier: InquiringCertifier):
+        self.rpc = rpc_client
+        self.certifier = certifier
+        self.source = HTTPProvider(rpc_client)
+
+    def _certified_commit(self, height: int) -> FullCommit:
+        fc = self.source.get_by_height(height)
+        if fc is None or fc.height != height:
+            raise CertificationError(f"no commit for height {height}")
+        self.certifier.certify(fc)
+        return fc
+
+    def block(self, height: int) -> dict:
+        """lite/proxy: block + proof that it matches the certified
+        header."""
+        res = self.rpc.call("block", height=height)
+        block = Block.from_obj(res["block"])
+        fc = self._certified_commit(height)
+        if block.hash() != fc.signed_header.header.hash():
+            raise CertificationError(
+                f"block {height} does not match certified header")
+        return res
+
+    def commit(self, height: int) -> dict:
+        fc = self._certified_commit(height)
+        return {"header": fc.signed_header.header.to_obj(),
+                "commit": fc.signed_header.commit.to_obj(),
+                "certified": True}
+
+    def status(self) -> dict:
+        return self.rpc.call("status")
+
+    def validators(self, height: int) -> dict:
+        fc = self._certified_commit(height)
+        return {"block_height": height,
+                "validators": fc.validators.to_obj(),
+                "certified": True}
+
+    def tx(self, hash: bytes, prove: bool = True) -> dict:
+        """Tx + merkle proof verified against the certified header's
+        data_hash (lite/proxy/query.go semantics)."""
+        res = self.rpc.call("tx", hash=hash, prove=True)
+        height = res["height"]
+        fc = self._certified_commit(height)
+        proof = res.get("proof")
+        if proof is None:
+            raise CertificationError("node returned no tx proof")
+        from tendermint_tpu.ops import merkle
+        root = bytes.fromhex(proof["root_hash"])
+        if root != fc.signed_header.header.data_hash:
+            raise CertificationError("tx proof root != certified data_hash")
+        ok = merkle.verify_proof_host(
+            root, proof["total"], proof["index"],
+            bytes.fromhex(res["tx"]),
+            [bytes.fromhex(p) for p in proof["proof"]])
+        if not ok:
+            raise CertificationError("invalid tx merkle proof")
+        return res

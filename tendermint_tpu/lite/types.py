@@ -1,0 +1,76 @@
+"""Light-client data types (lite/commit.go).
+
+A SignedHeader is a header plus the commit that signed it; a FullCommit
+adds the validator set that did the signing — everything a light client
+needs to certify one height without executing blocks."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.types.block import BlockID, Commit, Header, PartSetHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class CertificationError(Exception):
+    pass
+
+
+class ValidatorsChangedError(CertificationError):
+    """Certification failed because the signing set is not the trusted
+    one — the caller should update through intermediate headers
+    (lite/dynamic_certifier.go ErrValidatorsChanged)."""
+
+
+@dataclass
+class SignedHeader:
+    header: Header
+    commit: Commit
+    block_id: BlockID
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    def to_obj(self):
+        return {"header": self.header.to_obj(),
+                "commit": self.commit.to_obj(),
+                "block_id": self.block_id.to_obj()}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(Header.from_obj(o["header"]),
+                   Commit.from_obj(o["commit"]),
+                   BlockID.from_obj(o["block_id"]))
+
+
+@dataclass
+class FullCommit:
+    """SignedHeader + the valset that signed it (lite.FullCommit)."""
+    signed_header: SignedHeader
+    validators: ValidatorSet
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.height
+
+    def validate_basic(self, chain_id: str) -> None:
+        h = self.signed_header.header
+        if h.chain_id != chain_id:
+            raise CertificationError(
+                f"wrong chain id {h.chain_id!r} (want {chain_id!r})")
+        if h.validators_hash != self.validators.hash():
+            raise CertificationError(
+                "validator set does not match header's validators_hash")
+        if self.signed_header.block_id.hash != h.hash():
+            raise CertificationError("commit is not for this header")
+
+    def to_obj(self):
+        return {"signed_header": self.signed_header.to_obj(),
+                "validators": self.validators.to_obj()}
+
+    @classmethod
+    def from_obj(cls, o):
+        return cls(SignedHeader.from_obj(o["signed_header"]),
+                   ValidatorSet.from_obj(o["validators"]))

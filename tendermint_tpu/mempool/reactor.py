@@ -68,25 +68,35 @@ class MempoolReactor(Reactor):
         return ps.height
 
     def _broadcast_tx_routine(self, peer) -> None:
-        """mempool/reactor.go:104 broadcastTxRoutine: walk the clist."""
+        """mempool/reactor.go:104 broadcastTxRoutine: walk the clist,
+        sending each tx to this peer at most once. The tip element is
+        parked on (next_wait), NOT re-sent on timeout; after the list
+        drains we restart from the front, with `sent` suppressing
+        re-sends of still-pending txs."""
         el = None
+        sent: set = set()   # tx counters already sent to this peer
         while not self._stopped and peer.running:
             if el is None:
                 el = self.mempool.txs.front_wait(timeout=0.5)
                 if el is None:
+                    sent.clear()  # mempool drained: forget history
                     continue
             mtx = el.value
-            # skip peers still catching up to the tx's admission height
-            h = self._peer_height(peer)
-            if h >= 0 and h < mtx.height - 1:
-                time.sleep(PEER_CATCHUP_SLEEP_S)
-                continue
-            if not el.removed:
-                ok = peer.send(MEMPOOL_CHANNEL, encoding.cdumps(
-                    {"type": "tx", "tx": mtx.tx.hex()}))
-                if not ok:
+            if mtx.counter not in sent and not el.removed:
+                # skip peers still catching up to the admission height
+                h = self._peer_height(peer)
+                if h >= 0 and h < mtx.height - 1:
                     time.sleep(PEER_CATCHUP_SLEEP_S)
                     continue
+                if not peer.send(MEMPOOL_CHANNEL, encoding.cdumps(
+                        {"type": "tx", "tx": mtx.tx.hex()})):
+                    time.sleep(PEER_CATCHUP_SLEEP_S)
+                    continue
+                sent.add(mtx.counter)
+                if len(sent) > 200_000:
+                    sent.clear()
             nxt = el.next_wait(timeout=0.5)
-            if nxt is not None or el.removed:
+            if nxt is not None:
                 el = nxt
+            elif el.removed:
+                el = None  # tip removed: restart from the live front

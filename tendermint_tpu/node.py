@@ -34,7 +34,7 @@ class Node:
     def __init__(self, config: Config, gen_doc: GenesisDoc,
                  priv_validator=None, app=None, client_creator=None,
                  mempool=None, evidence_pool=None, in_memory=False,
-                 with_p2p=False, fast_sync=False):
+                 with_p2p=False, fast_sync=False, with_rpc=False):
         self.config = config
         self.gen_doc = gen_doc
 
@@ -105,6 +105,23 @@ class Node:
         if with_p2p:
             self._build_p2p(state, fast_sync, in_memory)
 
+        self.rpc_server = None
+        self.rpc_address = None
+        self.with_rpc = with_rpc
+
+        # tx indexer + service (node/node.go:294-320)
+        from tendermint_tpu.state.txindex import (
+            IndexerService, KVTxIndexer, NullTxIndexer)
+        if config.tx_index.indexer == "kv":
+            tags = [t for t in config.tx_index.index_tags.split(",") if t]
+            self.tx_indexer = KVTxIndexer(
+                open_db(db_path("tx_index")), index_tags=tags,
+                index_all_tags=config.tx_index.index_all_tags)
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer,
+                                              self.event_bus)
+
     def _build_p2p(self, state, fast_sync: bool, in_memory: bool) -> None:
         """node/node.go:235-265: switch + reactors (+PEX)."""
         from tendermint_tpu.blockchain import BlockchainReactor
@@ -174,6 +191,14 @@ class Node:
         else:
             self.consensus.start()
 
+        self.indexer_service.start()
+
+        if self.with_rpc:
+            from tendermint_tpu.rpc import RPCEnv, make_server
+            self.rpc_server, _ = make_server(RPCEnv.from_node(self))
+            host, port = _parse_laddr(self.config.rpc.laddr)
+            self.rpc_address = self.rpc_server.serve(host, port)
+
     def _dial_configured_peers(self) -> None:
         from tendermint_tpu.p2p import NetAddress
         persistent = [a for a in
@@ -188,6 +213,9 @@ class Node:
                 [NetAddress.from_string(a) for a in seeds])
 
     def stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.indexer_service.stop()
         if self.switch is not None:
             self.switch.stop()
         else:

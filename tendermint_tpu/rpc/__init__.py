@@ -1,0 +1,13 @@
+from tendermint_tpu.rpc.client import (
+    JSONRPCClient,
+    LocalClient,
+    RPCClientError,
+    URIClient,
+    WSClient,
+)
+from tendermint_tpu.rpc.core import RPCCore, RPCEnv, jsonify, make_server
+from tendermint_tpu.rpc.server import RPCError, RPCServer
+
+__all__ = ["JSONRPCClient", "LocalClient", "RPCClientError", "RPCCore",
+           "RPCEnv", "RPCError", "RPCServer", "URIClient", "WSClient",
+           "jsonify", "make_server"]

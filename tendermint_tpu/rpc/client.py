@@ -1,0 +1,209 @@
+"""RPC clients (rpc/lib/client + rpc/client).
+
+JSONRPCClient  — HTTP POST JSON-RPC 2.0   (http_client.go:66)
+URIClient      — HTTP GET with URI params (http_client.go:109)
+WSClient       — websocket JSON-RPC + event stream (ws_client.go:30)
+LocalClient    — in-process dispatch against an RPCServer funcmap
+                 (rpc/client/localclient.go)
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+from urllib.parse import urlencode
+from urllib.request import Request, urlopen
+
+
+class RPCClientError(Exception):
+    def __init__(self, code, message, data=None):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.data = data
+
+
+def _unwrap(resp: dict) -> Any:
+    if resp.get("error"):
+        e = resp["error"]
+        raise RPCClientError(e.get("code"), e.get("message"),
+                             e.get("data"))
+    return resp.get("result")
+
+
+def _encode_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: (v.hex() if isinstance(v, (bytes, bytearray)) else v)
+            for k, v in params.items()}
+
+
+class JSONRPCClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, **params) -> Any:
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method,
+                           "params": _encode_params(params)}).encode()
+        req = Request(self.address, data=body,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=self.timeout) as resp:
+            return _unwrap(json.loads(resp.read()))
+
+
+class URIClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    def call(self, method: str, **params) -> Any:
+        url = f"{self.address}/{method}"
+        if params:
+            url += "?" + urlencode(_encode_params(params))
+        with urlopen(url, timeout=self.timeout) as resp:
+            return _unwrap(json.loads(resp.read()))
+
+
+class WSClient:
+    """Minimal RFC 6455 client for JSON-RPC + event subscriptions."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall((
+            f"GET /websocket HTTP/1.1\r\nHost: {host}:{port}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        # consume the 101 response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(1024)
+            if not chunk:
+                raise ConnectionError("ws handshake failed")
+            buf += chunk
+        if b" 101 " not in buf.split(b"\r\n", 1)[0]:
+            raise ConnectionError(f"ws upgrade refused: {buf[:120]!r}")
+        self._id = 0
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        self._replies: Dict[Any, "queue.Queue[dict]"] = {}
+        self._lock = threading.Lock()
+        self.open = True
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name="ws-client-read").start()
+
+    # ---------------------------------------------------------------- frames
+
+    def _send_text(self, text: str) -> None:
+        data = text.encode()
+        mask = os.urandom(4)
+        hdr = bytearray([0x81])
+        n = len(data)
+        if n < 126:
+            hdr.append(0x80 | n)
+        elif n < (1 << 16):
+            hdr.append(0x80 | 126)
+            hdr += struct.pack(">H", n)
+        else:
+            hdr.append(0x80 | 127)
+            hdr += struct.pack(">Q", n)
+        hdr += mask
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        with self._lock:
+            self.sock.sendall(bytes(hdr) + masked)
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_message(self) -> Optional[str]:
+        parts = []
+        while True:
+            hdr = self._read_exact(2)
+            if hdr is None:
+                return None
+            fin, opcode = hdr[0] & 0x80, hdr[0] & 0x0F
+            n = hdr[1] & 0x7F
+            if n == 126:
+                (n,) = struct.unpack(">H", self._read_exact(2) or b"\0\0")
+            elif n == 127:
+                (n,) = struct.unpack(">Q", self._read_exact(8) or b"\0" * 8)
+            payload = self._read_exact(n) if n else b""
+            if payload is None:
+                return None
+            if opcode == 0x8:
+                return None
+            if opcode in (0x9, 0xA):
+                continue
+            parts.append(payload)
+            if fin:
+                return b"".join(parts).decode()
+
+    def _read_loop(self) -> None:
+        while self.open:
+            text = self._read_message()
+            if text is None:
+                self.open = False
+                return
+            try:
+                msg = json.loads(text)
+            except ValueError:
+                continue
+            if msg.get("id") == "#event":
+                self.events.put(msg.get("result"))
+            else:
+                q = self._replies.pop(msg.get("id"), None)
+                if q is not None:
+                    q.put(msg)
+
+    # ------------------------------------------------------------------ api
+
+    def call(self, method: str, timeout: float = 30.0, **params) -> Any:
+        self._id += 1
+        id_ = self._id
+        q: "queue.Queue[dict]" = queue.Queue()
+        self._replies[id_] = q
+        self._send_text(json.dumps(
+            {"jsonrpc": "2.0", "id": id_, "method": method,
+             "params": _encode_params(params)}))
+        return _unwrap(q.get(timeout=timeout))
+
+    def subscribe(self, query: str) -> None:
+        self.call("subscribe", query=query)
+
+    def next_event(self, timeout: float = 30.0) -> dict:
+        return self.events.get(timeout=timeout)
+
+    def close(self) -> None:
+        self.open = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class LocalClient:
+    """In-process client: same interface, no sockets
+    (rpc/client/localclient.go)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def call(self, method: str, **params) -> Any:
+        from tendermint_tpu.rpc.core import jsonify
+        return jsonify(self.server.call(method, params))

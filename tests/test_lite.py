@@ -1,0 +1,232 @@
+"""Lite client tests (models lite/*_test.go): static/dynamic/inquiring
+certifiers, bisection through valset changes, providers, batch chain
+certification, and the proof-checking proxy against a live RPC node."""
+
+import pytest
+
+from tendermint_tpu.lite import (
+    CertificationError,
+    DynamicCertifier,
+    FileProvider,
+    FullCommit,
+    InquiringCertifier,
+    MemProvider,
+    SignedHeader,
+    StaticCertifier,
+    ValidatorsChangedError,
+    certify_chain,
+)
+from tendermint_tpu.types import PrivKey
+from tendermint_tpu.types.block import BlockID, Commit, Header, PartSetHeader
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+
+CHAIN = "lite-test"
+
+
+class ValKeys:
+    """Ordered keys matching a ValidatorSet (lite test helper, the
+    reference's ValKeys in lite/helpers.go)."""
+
+    def __init__(self, n, power=10, seed_base=1):
+        self.keys = [PrivKey.generate(bytes([seed_base + i]) * 32)
+                     for i in range(n)]
+        self.power = power
+        self.valset = ValidatorSet(
+            [Validator(k.pubkey.ed25519, power) for k in self.keys])
+
+    def sign_header(self, height, app_hash=b"\x01" * 32,
+                    first=0, last=None) -> FullCommit:
+        """FullCommit for a synthetic header signed by keys[first:last]."""
+        header = Header(chain_id=CHAIN, height=height, time_ns=height,
+                        validators_hash=self.valset.hash(),
+                        app_hash=app_hash)
+        bid = BlockID(header.hash(), PartSetHeader(1, b"\x22" * 32))
+        precommits = [None] * len(self.keys)
+        last = len(self.keys) if last is None else last
+        # sorted-by-address order must match the valset's
+        by_addr = {v.address: i for i, v in
+                   enumerate(self.valset.validators)}
+        for k in self.keys[first:last]:
+            idx = by_addr[k.pubkey.address]
+            v = Vote(k.pubkey.address, idx, height, 0, height,
+                     VoteType.PRECOMMIT, bid)
+            pv = PrivValidator(LocalSigner(k))
+            pv.sign_vote(CHAIN, v)
+            precommits[idx] = v
+        return FullCommit(SignedHeader(header, Commit(bid, precommits), bid),
+                          self.valset)
+
+
+def test_static_certifier_accepts_and_rejects():
+    vk = ValKeys(4)
+    cert = StaticCertifier(CHAIN, vk.valset)
+    cert.certify(vk.sign_header(5))
+    # only 2 of 4 signed: not +2/3
+    with pytest.raises(CertificationError):
+        cert.certify(vk.sign_header(6, last=2))
+    # different valset entirely
+    other = ValKeys(4, seed_base=50)
+    with pytest.raises(CertificationError):
+        cert.certify(other.sign_header(7))
+    # tampered header (valset hash mismatch caught structurally)
+    fc = vk.sign_header(8)
+    fc.signed_header.header.app_hash = b"\x99" * 32
+    with pytest.raises(CertificationError):
+        cert.certify(fc)
+
+
+def test_dynamic_certifier_updates_through_change():
+    vk = ValKeys(4)
+    cert = DynamicCertifier(CHAIN, vk.valset, height=1)
+    cert.certify(vk.sign_header(2))
+    # new set: 3 of the old 4 plus one new key — overlap way above +1/3
+    vk2 = ValKeys(4)
+    vk2.keys = vk.keys[:3] + [PrivKey.generate(b"\x63" * 32)]
+    vk2.valset = ValidatorSet(
+        [Validator(k.pubkey.ed25519, 10) for k in vk2.keys])
+    fc = ValKeysView(vk2).sign_header(10)
+    cert.update(fc)
+    assert cert.last_height == 10
+    cert.certify(ValKeysView(vk2).sign_header(11))
+    # old-set certify now fails
+    with pytest.raises(CertificationError):
+        cert.certify(vk.sign_header(12))
+
+
+class ValKeysView(ValKeys):
+    """Wrap an existing ValKeys-like object without re-generating keys."""
+
+    def __init__(self, src):
+        self.keys = src.keys
+        self.power = src.power
+        self.valset = src.valset
+
+
+def test_dynamic_update_rejects_insufficient_old_overlap():
+    vk = ValKeys(4)
+    cert = DynamicCertifier(CHAIN, vk.valset, height=1)
+    stranger = ValKeys(4, seed_base=80)  # zero overlap with trusted set
+    with pytest.raises(CertificationError):
+        cert.update(stranger.sign_header(10))
+
+
+def test_inquiring_certifier_bisects():
+    """Trust bridges a big valset jump via the provider's intermediate
+    commits (lite/inquiring_certifier.go:137-163)."""
+    vk1 = ValKeys(4)                       # heights 1-10
+    vk2 = ValKeysView(vk1)                 # rotate 1 key at height 10
+    vk2 = type("VK", (ValKeysView,), {})(vk1)
+    vk2.keys = vk1.keys[:3] + [PrivKey.generate(b"\x70" * 32)]
+    vk2.valset = ValidatorSet(
+        [Validator(k.pubkey.ed25519, 10) for k in vk2.keys])
+    # vk3 keeps K0 + vk2's new key: 2/4 overlap with vk2 (> 1/3) but only
+    # 1/4 with vk1 (< 1/3) -> direct update from height 1 must fail
+    vk3 = type("VK", (ValKeysView,), {})(vk2)
+    vk3.keys = [vk2.keys[0], vk2.keys[3]] + \
+        [PrivKey.generate(bytes([0x71 + i]) * 32) for i in range(2)]
+    vk3.valset = ValidatorSet(
+        [Validator(k.pubkey.ed25519, 10) for k in vk3.keys])
+
+    provider = MemProvider()
+    provider.store_commit(vk2.sign_header(10))   # the bridge commit
+    provider.store_commit(vk3.sign_header(20))
+
+    trusted = vk1.sign_header(1)
+    cert = InquiringCertifier(CHAIN, trusted, provider)
+    # direct update 1 -> 25 fails (vk3 overlaps vk1 by only 1/4 power);
+    # bisection finds height 10 (vk2: 3/4 overlap), then 20, then 25
+    cert.certify(vk3.sign_header(25))
+    assert cert.last_height >= 20
+
+
+def test_providers_roundtrip(tmp_path):
+    vk = ValKeys(3)
+    mem = MemProvider()
+    f = FileProvider(str(tmp_path / "certs"))
+    for p in (mem, f):
+        p.store_commit(vk.sign_header(5))
+        p.store_commit(vk.sign_header(9))
+        assert p.get_by_height(9).height == 9
+        assert p.get_by_height(7).height == 5   # largest <= 7
+        assert p.get_by_height(4) is None
+        assert p.latest_commit().height == 9
+    # file provider round-trips through JSON intact
+    fc = f.get_by_height(9)
+    StaticCertifier(CHAIN, vk.valset).certify(fc)
+
+
+def test_certify_chain_batches_and_detects_forgery():
+    vk = ValKeys(4)
+    chain = [vk.sign_header(h) for h in range(1, 9)]
+    certify_chain(CHAIN, chain)  # one pooled batch
+
+    # forge one signature mid-chain
+    bad = [vk.sign_header(h) for h in range(1, 9)]
+    victim = bad[4].signed_header.commit.precommits[1]
+    victim.signature = b"\x00" * 64
+    with pytest.raises(CertificationError) as e:
+        certify_chain(CHAIN, bad)
+    assert "height 5" in str(e.value)
+
+    # valset discontinuity split point surfaces as ValidatorsChanged
+    other = ValKeys(4, seed_base=40)
+    mixed = chain[:3] + [other.sign_header(4)]
+    with pytest.raises(ValidatorsChangedError):
+        certify_chain(CHAIN, mixed)
+
+
+def test_secure_proxy_against_live_node():
+    """SecureClient verifies blocks/commits/txs from a real RPC node."""
+    import time
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.lite.provider import HTTPProvider
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc import JSONRPCClient
+    from tendermint_tpu.lite import SecureClient
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    key = PrivKey.generate(b"\x0c" * 32)
+    gen = GenesisDoc(chain_id="lite-live", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    cfg = make_test_config("")
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.tx_index.index_all_tags = True
+    node = Node(cfg, gen,
+                priv_validator=PrivValidator(LocalSigner(key)),
+                in_memory=True, with_rpc=True)
+    node.start()
+    try:
+        host, port = node.rpc_address
+        rpc = JSONRPCClient(f"http://{host}:{port}")
+        rpc.call("broadcast_tx_commit", tx=b"lite=proof")
+        deadline = time.monotonic() + 30
+        while node.height < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+        provider = HTTPProvider(rpc)
+        trusted = provider.get_by_height(1)
+        assert trusted is not None
+        cert = InquiringCertifier("lite-live", trusted, MemProvider())
+        sc = SecureClient(rpc, cert)
+        blk = sc.block(2)
+        assert blk["block"]["header"]["height"] == 2
+        cm = sc.commit(2)
+        assert cm["certified"]
+        vals = sc.validators(2)
+        assert vals["certified"]
+        # tx with verified merkle proof
+        import hashlib
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                res = sc.tx(hashlib.sha256(b"lite=proof").digest())
+                break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            pytest.fail("tx never certified")
+        assert bytes.fromhex(res["tx"]) == b"lite=proof"
+    finally:
+        node.stop()

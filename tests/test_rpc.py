@@ -1,0 +1,181 @@
+"""RPC tests: JSON-RPC server framework (POST/URI/WS), core routes over a
+live node, clients (models rpc/lib tests + rpc/core behavior)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.rpc import (
+    JSONRPCClient,
+    RPCClientError,
+    RPCError,
+    RPCServer,
+    URIClient,
+    WSClient,
+)
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+
+# ------------------------------------------------------------- lib framework
+
+def make_lib_server():
+    srv = RPCServer()
+    srv.register("add", lambda a: int(a) + 1)
+    srv.register("concat", lambda x, y="def": f"{x}{y}")
+    srv.register("boom", lambda: 1 / 0)
+
+    def typed(n: int = 0, flag: bool = False, blob: bytes = b""):
+        return {"n": n, "flag": flag, "blob": blob.hex()}
+    srv.register("typed", typed)
+    addr = srv.serve("127.0.0.1", 0)
+    return srv, addr
+
+
+def test_jsonrpc_post_and_uri_roundtrip():
+    srv, (host, port) = make_lib_server()
+    try:
+        http = JSONRPCClient(f"http://{host}:{port}")
+        assert http.call("add", a=41) == 42
+        assert http.call("concat", x="abc") == "abcdef"
+        uri = URIClient(f"http://{host}:{port}")
+        assert uri.call("add", a=41) == 42
+        # URI string params coerced to annotated types
+        assert uri.call("typed", n="7", flag="true", blob="beef") == \
+            {"n": 7, "flag": True, "blob": "beef"}
+    finally:
+        srv.stop()
+
+
+def test_rpc_errors_surface():
+    srv, (host, port) = make_lib_server()
+    try:
+        http = JSONRPCClient(f"http://{host}:{port}")
+        with pytest.raises(RPCClientError) as e:
+            http.call("nope")
+        assert e.value.code == -32601
+        with pytest.raises(RPCClientError) as e:
+            http.call("boom")  # handler exception -> structured error
+        assert e.value.code == -32603
+        with pytest.raises(RPCClientError):
+            http.call("add")   # missing param
+    finally:
+        srv.stop()
+
+
+def test_websocket_jsonrpc_call():
+    srv, (host, port) = make_lib_server()
+    try:
+        ws = WSClient(host, port)
+        assert ws.call("add", a=1) == 2
+        assert ws.call("concat", x="a", y="b") == "ab"
+        ws.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ node + routes
+
+@pytest.fixture(scope="module")
+def rpc_node():
+    key = PrivKey.generate(b"\x0a" * 32)
+    gen = GenesisDoc(chain_id="rpc-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    cfg = make_test_config("")
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.unsafe = True
+    node = Node(cfg, gen, priv_validator=PrivValidator(LocalSigner(key)),
+                in_memory=True, with_rpc=True)
+    node.start()
+    deadline = time.monotonic() + 30
+    while node.height < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert node.height >= 2
+    yield node
+    node.stop()
+
+
+def client(node):
+    host, port = node.rpc_address
+    return JSONRPCClient(f"http://{host}:{port}")
+
+
+def test_status_and_genesis(rpc_node):
+    c = client(rpc_node)
+    st = c.call("status")
+    assert st["latest_block_height"] >= 2
+    assert st["latest_block_hash"]
+    g = c.call("genesis")
+    assert g["genesis"]["chain_id"] == "rpc-test"
+
+
+def test_block_blockchain_commit_validators(rpc_node):
+    c = client(rpc_node)
+    info = c.call("blockchain", min_height=1, max_height=2)
+    assert len(info["block_metas"]) == 2
+    assert info["block_metas"][0]["header"]["height"] == 2  # newest first
+    blk = c.call("block", height=1)
+    assert blk["block"]["header"]["height"] == 1
+    cm = c.call("commit", height=1)
+    assert cm["canonical"] is True
+    assert cm["commit"]["precommits"]
+    vals = c.call("validators")
+    assert len(vals["validators"]["validators"]) == 1
+    with pytest.raises(RPCClientError):
+        c.call("block", height=10**9)
+
+
+def test_broadcast_tx_sync_and_commit(rpc_node):
+    c = client(rpc_node)
+    res = c.call("broadcast_tx_sync", tx=b"rpc-key=rpc-val")
+    assert res["code"] == 0
+    # the tx lands in a block
+    res2 = c.call("broadcast_tx_commit", tx=b"rpc-commit=yes")
+    assert res2["deliver_tx"]["code"] == 0
+    assert res2["height"] >= 1
+    assert rpc_node.app.store.get(b"rpc-commit") == b"yes"
+
+
+def test_abci_query_and_info(rpc_node):
+    c = client(rpc_node)
+    c.call("broadcast_tx_commit", tx=b"qk=qv")
+    res = c.call("abci_query", path="/store", data=b"qk")
+    assert bytes.fromhex(res["response"]["value"]) == b"qv"
+    info = c.call("abci_info")
+    assert "kvstore" in info["response"]["data"]
+
+
+def test_unconfirmed_and_unsafe_flush(rpc_node):
+    c = client(rpc_node)
+    assert "n_txs" in c.call("num_unconfirmed_txs")
+    assert c.call("unsafe_flush_mempool") == {}
+
+
+def test_dump_consensus_state_and_net_info(rpc_node):
+    c = client(rpc_node)
+    dcs = c.call("dump_consensus_state")
+    assert dcs["round_state"]["height"] >= 1
+    ni = c.call("net_info")
+    assert ni["listening"] is False  # no p2p in this node
+
+
+def test_ws_subscribe_new_block(rpc_node):
+    host, port = rpc_node.rpc_address
+    ws = WSClient(host, port)
+    ws.subscribe("tm.event = 'NewBlock'")
+    ev = ws.next_event(timeout=30)
+    assert ev["data"]["block"]["header"]["height"] >= 1
+    ws.close()
+
+
+def test_ws_subscribe_tx_event(rpc_node):
+    host, port = rpc_node.rpc_address
+    ws = WSClient(host, port)
+    ws.subscribe("tm.event = 'Tx'")
+    c = client(rpc_node)
+    c.call("broadcast_tx_sync", tx=b"wsevent=1")
+    ev = ws.next_event(timeout=30)
+    assert bytes.fromhex(ev["data"]["tx"]) == b"wsevent=1"
+    ws.close()

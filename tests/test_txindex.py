@@ -1,0 +1,120 @@
+"""Tx indexer tests (models state/txindex/kv/kv_test.go) + the tx /
+tx_search RPC routes over a live node."""
+
+import hashlib
+import time
+
+import pytest
+
+from tendermint_tpu.state.txindex import IndexerService, KVTxIndexer, NullTxIndexer
+from tendermint_tpu.storage import MemDB
+
+
+def entry(height, index, tx, tags=None, code=0):
+    return {"height": height, "index": index, "tx": tx,
+            "result": {"code": code}, "tags": dict(tags or {})}
+
+
+def test_kv_index_get_by_hash():
+    idx = KVTxIndexer(MemDB(), index_all_tags=True)
+    idx.add_batch([entry(1, 0, b"tx-one", {"account.name": "alice"})])
+    h = hashlib.sha256(b"tx-one").digest()
+    rec = idx.get(h)
+    assert rec["height"] == 1 and rec["tx"] == b"tx-one"
+    assert idx.get(b"\x00" * 32) is None
+
+
+def test_kv_search_by_tag_and_hash():
+    idx = KVTxIndexer(MemDB(), index_all_tags=True)
+    idx.add_batch([
+        entry(1, 0, b"a", {"account.name": "alice"}),
+        entry(1, 1, b"b", {"account.name": "bob"}),
+        entry(2, 0, b"c", {"account.name": "alice"}),
+    ])
+    res = idx.search("account.name = 'alice'")
+    assert [r["tx"] for r in res] == [b"a", b"c"]  # height order
+    h = hashlib.sha256(b"b").digest()
+    res = idx.search(f"tx.hash = '{h.hex()}'")
+    assert [r["tx"] for r in res] == [b"b"]
+
+
+def test_kv_search_height_ranges():
+    idx = KVTxIndexer(MemDB(), index_all_tags=True)
+    idx.add_batch([entry(h, 0, b"tx%d" % h) for h in range(1, 8)])
+    assert [r["height"] for r in idx.search("tx.height > 5")] == [6, 7]
+    assert [r["height"] for r in idx.search("tx.height <= 2")] == [1, 2]
+    assert [r["height"]
+            for r in idx.search("tx.height > 2 AND tx.height < 5")] == [3, 4]
+
+
+def test_kv_selective_tags():
+    idx = KVTxIndexer(MemDB(), index_tags=["app.key"])
+    idx.add_batch([entry(1, 0, b"x", {"app.key": "k1", "secret": "v"})])
+    assert len(idx.search("app.key = 'k1'")) == 1
+    assert idx.search("secret = 'v'") == []
+
+
+def test_null_indexer():
+    idx = NullTxIndexer()
+    idx.add_batch([entry(1, 0, b"z")])
+    assert idx.get(hashlib.sha256(b"z").digest()) is None
+    assert idx.search("tx.height > 0") == []
+
+
+def test_indexer_service_feeds_from_event_bus():
+    from tendermint_tpu.abci.types import ResultDeliverTx
+    from tendermint_tpu.types.events import EventBus
+    bus = EventBus()
+    idx = KVTxIndexer(MemDB(), index_all_tags=True)
+    svc = IndexerService(idx, bus)
+    svc.start()
+    bus.publish_tx(5, 0, b"evtx", ResultDeliverTx(tags={"k": "v"}))
+    deadline = time.monotonic() + 5
+    h = hashlib.sha256(b"evtx").digest()
+    while time.monotonic() < deadline and idx.get(h) is None:
+        time.sleep(0.02)
+    rec = idx.get(h)
+    assert rec is not None and rec["height"] == 5
+    assert idx.search("k = 'v'")
+    svc.stop()
+
+
+def test_tx_rpc_routes_live():
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc import JSONRPCClient
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+    from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+    key = PrivKey.generate(b"\x0b" * 32)
+    gen = GenesisDoc(chain_id="txi-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    cfg = make_test_config("")
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.tx_index.index_all_tags = True
+    node = Node(cfg, gen, priv_validator=PrivValidator(LocalSigner(key)),
+                in_memory=True, with_rpc=True)
+    node.start()
+    try:
+        host, port = node.rpc_address
+        c = JSONRPCClient(f"http://{host}:{port}")
+        res = c.call("broadcast_tx_commit", tx=b"find=me")
+        tx_hash = hashlib.sha256(b"find=me").digest()
+        # give the indexer service a beat to drain the event
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                rec = c.call("tx", hash=tx_hash, prove=True)
+                break
+            except Exception:
+                time.sleep(0.05)
+        else:
+            pytest.fail("tx never indexed")
+        assert bytes.fromhex(rec["tx"]) == b"find=me"
+        assert rec["proof"]["total"] >= 1
+        found = c.call("tx_search", query="app.key = 'find'")
+        assert found["total_count"] >= 1
+        byh = c.call("tx_search", query=f"tx.height = {rec['height']}")
+        assert byh["total_count"] >= 1
+    finally:
+        node.stop()
