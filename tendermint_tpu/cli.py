@@ -130,8 +130,11 @@ def cmd_lite(args) -> int:
     if trusted is None:
         print("cannot fetch a trusted commit from the node")
         return 1
+    # the node itself is layered in as the outermost provider: bisection
+    # must be able to FETCH intermediate commits, not just read the cache
     store = CacheProvider(
-        MemProvider(), FileProvider(os.path.join(args.home, "lite")))
+        MemProvider(), FileProvider(os.path.join(args.home, "lite")),
+        source)
     chain_id = args.chain_id or \
         rpc.call("genesis")["genesis"]["chain_id"]
     cert = InquiringCertifier(chain_id, trusted, store)

@@ -1,0 +1,401 @@
+// Native host-side runtime ops for tendermint_tpu.
+//
+// The TPU handles the batched crypto plane (ops/ed25519.py, ops/merkle.py
+// device paths); this library covers the HOST hot paths that the
+// reference runs in Go (tmlibs/merkle, part-set hashing): whole merkle
+// trees and batched SHA-256 in single C calls instead of thousands of
+// per-node interpreter->OpenSSL round trips.
+//
+// Spec must stay bit-identical to ops/merkle.py's host reference:
+//   leaf  = SHA256(0x00 || item)
+//   node  = SHA256(0x01 || left || right)
+//   pad   = 32 zero bytes
+//   root  = SHA256(0x02 || uint64_le(n) || tree_root)
+//
+// Exported with a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+// --------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4) — portable compress + SHA-NI hardware compress with
+// runtime dispatch (the merkle tree is thousands of small hashes; SHA-NI
+// is ~5x the portable path)
+// --------------------------------------------------------------------------
+
+namespace {
+
+#if defined(__x86_64__)
+__attribute__((target("sha,sse4.1,ssse3")))
+void compress_shani(uint32_t state[8], const uint8_t *data) {
+  // Intel's canonical one-block SHA-NI schedule.
+  __m128i STATE0, STATE1, MSG, TMP, MSG0, MSG1, MSG2, MSG3;
+  __m128i ABEF_SAVE, CDGH_SAVE;
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+
+  TMP = _mm_loadu_si128((const __m128i *)&state[0]);
+  STATE1 = _mm_loadu_si128((const __m128i *)&state[4]);
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);
+  STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);
+
+  ABEF_SAVE = STATE0;
+  CDGH_SAVE = STATE1;
+
+#define QROUND(MSGV, K_HI, K_LO)                                     \
+  MSG = _mm_add_epi32(MSGV, _mm_set_epi64x(K_HI, K_LO));             \
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);               \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                                \
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+  MSG0 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i *)(data + 0)), MASK);
+  QROUND(MSG0, 0xE9B5DBA5B5C0FBCFULL, 0x71374491428A2F98ULL);
+
+  MSG1 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i *)(data + 16)), MASK);
+  QROUND(MSG1, 0xAB1C5ED5923F82A4ULL, 0x59F111F13956C25BULL);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+
+  MSG2 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i *)(data + 32)), MASK);
+  QROUND(MSG2, 0x550C7DC3243185BEULL, 0x12835B01D807AA98ULL);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+
+  MSG3 = _mm_shuffle_epi8(
+      _mm_loadu_si128((const __m128i *)(data + 48)), MASK);
+  QROUND(MSG3, 0xC19BF1749BDC06A7ULL, 0x80DEB1FE72BE5D74ULL);
+  TMP = _mm_alignr_epi8(MSG3, MSG2, 4);
+  MSG0 = _mm_add_epi32(MSG0, TMP);
+  MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+  MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+
+  QROUND(MSG0, 0x240CA1CC0FC19DC6ULL, 0xEFBE4786E49B69C1ULL);
+  TMP = _mm_alignr_epi8(MSG0, MSG3, 4);
+  MSG1 = _mm_add_epi32(MSG1, TMP);
+  MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+
+  QROUND(MSG1, 0x76F988DA5CB0A9DCULL, 0x4A7484AA2DE92C6FULL);
+  TMP = _mm_alignr_epi8(MSG1, MSG0, 4);
+  MSG2 = _mm_add_epi32(MSG2, TMP);
+  MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+
+  QROUND(MSG2, 0xBF597FC7B00327C8ULL, 0xA831C66D983E5152ULL);
+  TMP = _mm_alignr_epi8(MSG2, MSG1, 4);
+  MSG3 = _mm_add_epi32(MSG3, TMP);
+  MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+
+  QROUND(MSG3, 0x1429296706CA6351ULL, 0xD5A79147C6E00BF3ULL);
+  TMP = _mm_alignr_epi8(MSG3, MSG2, 4);
+  MSG0 = _mm_add_epi32(MSG0, TMP);
+  MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+  MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+
+  QROUND(MSG0, 0x53380D134D2C6DFCULL, 0x2E1B213827B70A85ULL);
+  TMP = _mm_alignr_epi8(MSG0, MSG3, 4);
+  MSG1 = _mm_add_epi32(MSG1, TMP);
+  MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+
+  QROUND(MSG1, 0x92722C8581C2C92EULL, 0x766A0ABB650A7354ULL);
+  TMP = _mm_alignr_epi8(MSG1, MSG0, 4);
+  MSG2 = _mm_add_epi32(MSG2, TMP);
+  MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+
+  QROUND(MSG2, 0xC76C51A3C24B8B70ULL, 0xA81A664BA2BFE8A1ULL);
+  TMP = _mm_alignr_epi8(MSG2, MSG1, 4);
+  MSG3 = _mm_add_epi32(MSG3, TMP);
+  MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+
+  QROUND(MSG3, 0x106AA070F40E3585ULL, 0xD6990624D192E819ULL);
+  TMP = _mm_alignr_epi8(MSG3, MSG2, 4);
+  MSG0 = _mm_add_epi32(MSG0, TMP);
+  MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+  MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+
+  QROUND(MSG0, 0x34B0BCB52748774CULL, 0x1E376C0819A4C116ULL);
+  TMP = _mm_alignr_epi8(MSG0, MSG3, 4);
+  MSG1 = _mm_add_epi32(MSG1, TMP);
+  MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+
+  QROUND(MSG1, 0x682E6FF35B9CCA4FULL, 0x4ED8AA4A391C0CB3ULL);
+  TMP = _mm_alignr_epi8(MSG1, MSG0, 4);
+  MSG2 = _mm_add_epi32(MSG2, TMP);
+  MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+
+  QROUND(MSG2, 0x8CC7020884C87814ULL, 0x78A5636F748F82EEULL);
+  TMP = _mm_alignr_epi8(MSG2, MSG1, 4);
+  MSG3 = _mm_add_epi32(MSG3, TMP);
+  MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+
+  QROUND(MSG3, 0xC67178F2BEF9A3F7ULL, 0xA4506CEB90BEFFFAULL);
+#undef QROUND
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);
+
+  _mm_storeu_si128((__m128i *)&state[0], STATE0);
+  _mm_storeu_si128((__m128i *)&state[4], STATE1);
+}
+
+bool has_shani() {
+  static const bool ok = __builtin_cpu_supports("sha") &&
+                         __builtin_cpu_supports("sse4.1") &&
+                         __builtin_cpu_supports("ssse3");
+  return ok;
+}
+#endif  // __x86_64__
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_len = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static inline uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void compress(const uint8_t *p) {
+#if defined(__x86_64__)
+    if (has_shani()) {
+      compress_shani(h, p);
+      return;
+    }
+#endif
+    compress_portable(p);
+  }
+
+  void compress_portable(const uint8_t *p) {
+    static const uint32_t K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t *data, size_t n) {
+    len += n;
+    if (buf_len) {
+      size_t take = 64 - buf_len;
+      if (take > n) take = n;
+      std::memcpy(buf + buf_len, data, take);
+      buf_len += take;
+      data += take;
+      n -= take;
+      if (buf_len == 64) {
+        compress(buf);
+        buf_len = 0;
+      }
+    }
+    while (n >= 64) {
+      compress(data);
+      data += 64;
+      n -= 64;
+    }
+    if (n) {
+      std::memcpy(buf, data, n);
+      buf_len = n;
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_len != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+inline void sha256_one(const uint8_t *data, size_t n, uint8_t out[32]) {
+  Sha256 s;
+  s.update(data, n);
+  s.final(out);
+}
+
+inline void leaf_hash(const uint8_t *item, size_t n, uint8_t out[32]) {
+  Sha256 s;
+  uint8_t p = 0x00;
+  s.update(&p, 1);
+  s.update(item, n);
+  s.final(out);
+}
+
+inline void node_hash(const uint8_t *l, const uint8_t *r, uint8_t out[32]) {
+  Sha256 s;
+  uint8_t p = 0x01;
+  s.update(&p, 1);
+  s.update(l, 32);
+  s.update(r, 32);
+  s.final(out);
+}
+
+inline void final_hash(uint64_t n, const uint8_t *tree_root,
+                       uint8_t out[32]) {
+  Sha256 s;
+  uint8_t p = 0x02;
+  s.update(&p, 1);
+  uint8_t nb[8];
+  for (int i = 0; i < 8; i++) nb[i] = uint8_t(n >> (8 * i));  // LE
+  s.update(nb, 8);
+  s.update(tree_root, 32);
+  s.final(out);
+}
+
+size_t padded_size(size_t n) {
+  size_t m = 1;
+  while (m < n) m *= 2;
+  return m;
+}
+
+void root_from_digests(std::vector<uint8_t> &level, size_t n_real,
+                       uint8_t out[32]) {
+  // level holds padded digests contiguously (k * 32 bytes, k power of 2)
+  size_t k = level.size() / 32;
+  while (k > 1) {
+    for (size_t i = 0; i < k; i += 2)
+      node_hash(&level[32 * i], &level[32 * (i + 1)], &level[32 * (i / 2)]);
+    k /= 2;
+  }
+  final_hash(n_real, level.data(), out);
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+// C ABI
+// --------------------------------------------------------------------------
+
+extern "C" {
+
+// Batched SHA-256: items concatenated in `data`, bounds in offsets[n+1].
+void tm_sha256_batch(const uint8_t *data, const uint64_t *offsets,
+                     uint64_t n, uint8_t *out /* n*32 */) {
+  for (uint64_t i = 0; i < n; i++)
+    sha256_one(data + offsets[i], offsets[i + 1] - offsets[i],
+               out + 32 * i);
+}
+
+// Merkle root over raw items (ops/merkle.py root_host).
+void tm_merkle_root(const uint8_t *data, const uint64_t *offsets,
+                    uint64_t n, uint8_t *out /* 32 */) {
+  if (n == 0) {
+    uint8_t zero[32] = {0};
+    final_hash(0, zero, out);
+    return;
+  }
+  size_t m = padded_size(n);
+  std::vector<uint8_t> level(m * 32, 0);
+  for (uint64_t i = 0; i < n; i++)
+    leaf_hash(data + offsets[i], offsets[i + 1] - offsets[i],
+              &level[32 * i]);
+  root_from_digests(level, n, out);
+}
+
+// Merkle root over precomputed 32-byte leaf digests.
+void tm_merkle_root_from_digests(const uint8_t *digests, uint64_t n,
+                                 uint8_t *out /* 32 */) {
+  if (n == 0) {
+    uint8_t zero[32] = {0};
+    final_hash(0, zero, out);
+    return;
+  }
+  size_t m = padded_size(n);
+  std::vector<uint8_t> level(m * 32, 0);
+  std::memcpy(level.data(), digests, size_t(n) * 32);
+  root_from_digests(level, n, out);
+}
+
+// Merkle proof (aunts leaf-up) for item `index`; out_aunts has
+// log2(padded(n)) * 32 bytes; returns the depth.
+uint64_t tm_merkle_proof(const uint8_t *data, const uint64_t *offsets,
+                         uint64_t n, uint64_t index, uint8_t *out_root,
+                         uint8_t *out_aunts) {
+  size_t m = padded_size(n);
+  std::vector<uint8_t> level(m * 32, 0);
+  for (uint64_t i = 0; i < n; i++)
+    leaf_hash(data + offsets[i], offsets[i + 1] - offsets[i],
+              &level[32 * i]);
+  uint64_t depth = 0;
+  size_t idx = index;
+  size_t k = m;
+  while (k > 1) {
+    std::memcpy(out_aunts + 32 * depth, &level[32 * (idx ^ 1)], 32);
+    for (size_t i = 0; i < k; i += 2)
+      node_hash(&level[32 * i], &level[32 * (i + 1)], &level[32 * (i / 2)]);
+    k /= 2;
+    idx /= 2;
+    depth++;
+  }
+  final_hash(n, level.data(), out_root);
+  return depth;
+}
+
+}  // extern "C"

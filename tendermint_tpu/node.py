@@ -158,6 +158,13 @@ class Node:
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
 
+        from tendermint_tpu.p2p.trust import TrustMetricStore
+        from tendermint_tpu.storage import open_db as _open
+        self.trust_store = TrustMetricStore(
+            _open(None if in_memory else
+                  self.config.path(self.config.base.db_dir, "trust.db")))
+        self.switch.trust_store = self.trust_store
+
         if self.config.p2p.pex:
             from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
             book_path = None if in_memory else \
@@ -218,6 +225,8 @@ class Node:
         self.indexer_service.stop()
         if self.switch is not None:
             self.switch.stop()
+            if getattr(self, "trust_store", None) is not None:
+                self.trust_store.save()
         else:
             self.consensus.stop()
         if hasattr(self.mempool, "close"):

@@ -59,7 +59,13 @@ def _padded_size(n: int) -> int:
 
 
 def root_host(items: list[bytes]) -> bytes:
-    """Merkle root of raw items, entirely on host."""
+    """Merkle root of raw items, entirely on host. Uses the native C++
+    tree builder (native/hostops.cpp) when available — one C call per
+    tree instead of 2n hashlib round trips."""
+    from tendermint_tpu import native
+    out = native.merkle_root(items)
+    if out is not None:
+        return out
     return root_from_digests_host([leaf_hash(it) for it in items])
 
 
@@ -67,6 +73,10 @@ def root_from_digests_host(digests: list[bytes]) -> bytes:
     n = len(digests)
     if n == 0:
         return _final_hash(0, EMPTY_DIGEST)
+    from tendermint_tpu import native
+    out = native.merkle_root_from_digests(list(digests))
+    if out is not None:
+        return out
     level = list(digests) + [EMPTY_DIGEST] * (_padded_size(n) - n)
     while len(level) > 1:
         level = [node_hash(level[i], level[i + 1]) for i in range(0, len(level), 2)]
@@ -77,6 +87,10 @@ def proof_host(items: list[bytes], index: int):
     """Returns (root, aunts) — aunts leaf-up, each 32 bytes."""
     n = len(items)
     assert 0 <= index < n
+    from tendermint_tpu import native
+    native_out = native.merkle_proof(items, index)
+    if native_out is not None:
+        return native_out
     level = [leaf_hash(it) for it in items] + \
         [EMPTY_DIGEST] * (_padded_size(n) - n)
     aunts = []

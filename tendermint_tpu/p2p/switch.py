@@ -64,6 +64,8 @@ class Switch:
         self.id_filters: List[Callable[[str], None]] = []
         # addr book hook (set by the PEX reactor)
         self.addr_book = None
+        # optional TrustMetricStore: good on handshake, bad on error-stop
+        self.trust_store = None
 
     # ------------------------------------------------------------- reactors
 
@@ -263,6 +265,8 @@ class Switch:
             link.close()
             raise SwitchError(f"duplicate peer {peer.id}")
         peer.start()
+        if self.trust_store is not None:
+            self.trust_store.get_metric(peer.id).good_events(1)
         for reactor in self.reactors.values():
             try:
                 reactor.add_peer(peer)
@@ -287,6 +291,8 @@ class Switch:
 
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """switch.go StopPeerForError + reconnect for persistent peers."""
+        if self.trust_store is not None:
+            self.trust_store.get_metric(peer.id).bad_events(1)
         self._remove_peer(peer, reason)
         if peer.persistent and peer.dial_addr is not None and \
                 not self._stopped:
@@ -306,6 +312,8 @@ class Switch:
                 reactor.remove_peer(peer, reason)
             except Exception:
                 pass
+        if self.trust_store is not None:
+            self.trust_store.peer_disconnected(peer.id)
 
     def _connected_to(self, addr: NetAddress) -> bool:
         """Already connected to this address? Matches by ID when known,

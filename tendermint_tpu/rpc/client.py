@@ -140,9 +140,15 @@ class WSClient:
             fin, opcode = hdr[0] & 0x80, hdr[0] & 0x0F
             n = hdr[1] & 0x7F
             if n == 126:
-                (n,) = struct.unpack(">H", self._read_exact(2) or b"\0\0")
+                ext = self._read_exact(2)
+                if ext is None:
+                    return None  # truncated frame = connection gone
+                (n,) = struct.unpack(">H", ext)
             elif n == 127:
-                (n,) = struct.unpack(">Q", self._read_exact(8) or b"\0" * 8)
+                ext = self._read_exact(8)
+                if ext is None:
+                    return None
+                (n,) = struct.unpack(">Q", ext)
             payload = self._read_exact(n) if n else b""
             if payload is None:
                 return None
@@ -178,10 +184,18 @@ class WSClient:
         id_ = self._id
         q: "queue.Queue[dict]" = queue.Queue()
         self._replies[id_] = q
-        self._send_text(json.dumps(
-            {"jsonrpc": "2.0", "id": id_, "method": method,
-             "params": _encode_params(params)}))
-        return _unwrap(q.get(timeout=timeout))
+        try:
+            self._send_text(json.dumps(
+                {"jsonrpc": "2.0", "id": id_, "method": method,
+                 "params": _encode_params(params)}))
+            try:
+                reply = q.get(timeout=timeout)
+            except queue.Empty:
+                raise RPCClientError(
+                    -32000, f"no reply to {method!r} within {timeout}s")
+            return _unwrap(reply)
+        finally:
+            self._replies.pop(id_, None)
 
     def subscribe(self, query: str) -> None:
         self.call("subscribe", query=query)

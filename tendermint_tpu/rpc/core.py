@@ -38,7 +38,8 @@ class RPCEnv:
     def __init__(self, consensus=None, block_store=None, state_store=None,
                  mempool=None, evidence_pool=None, switch=None,
                  event_bus=None, tx_indexer=None, gen_doc=None,
-                 app_conns=None, pubkey: bytes = b"", unsafe: bool = False):
+                 app_conns=None, pubkey: bytes = b"", unsafe: bool = False,
+                 blockchain_reactor=None):
         self.consensus = consensus
         self.block_store = block_store
         self.state_store = state_store
@@ -51,6 +52,7 @@ class RPCEnv:
         self.app_conns = app_conns
         self.pubkey = pubkey
         self.unsafe = unsafe
+        self.blockchain_reactor = blockchain_reactor
 
     @classmethod
     def from_node(cls, node) -> "RPCEnv":
@@ -63,7 +65,8 @@ class RPCEnv:
             gen_doc=node.gen_doc, app_conns=node.app_conns,
             pubkey=(node.consensus.priv_validator.pubkey.ed25519
                     if node.consensus.priv_validator else b""),
-            unsafe=node.config.rpc.unsafe)
+            unsafe=node.config.rpc.unsafe,
+            blockchain_reactor=getattr(node, "blockchain_reactor", None))
 
 
 class RPCCore:
@@ -95,6 +98,9 @@ class RPCCore:
             r.update({
                 "dial_peers": self.dial_peers,
                 "unsafe_flush_mempool": self.unsafe_flush_mempool,
+                "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
+                "unsafe_stop_cpu_profiler": self.unsafe_stop_cpu_profiler,
+                "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
             })
         return r
 
@@ -125,7 +131,8 @@ class RPCCore:
             "latest_app_hash": cs.state.app_hash if cs else b"",
             "latest_block_time_ns":
                 meta.header.time_ns if meta else 0,
-            "syncing": (not getattr(cs, "replay_mode", False) and cs is None),
+            "syncing": (self.env.blockchain_reactor is not None and
+                        not self.env.blockchain_reactor.synced),
         })
 
     def net_info(self) -> dict:
@@ -295,6 +302,39 @@ class RPCCore:
     def unsafe_flush_mempool(self) -> dict:
         self.env.mempool.flush()
         return {}
+
+    # profiling (rpc/core/dev.go:23-43; cProfile/tracemalloc instead of
+    # Go's pprof)
+
+    _profiler = None
+
+    def unsafe_start_cpu_profiler(self, filename: str = "") -> dict:
+        import cProfile
+        if RPCCore._profiler is not None:
+            raise RPCError(-32000, "profiler already running")
+        RPCCore._profiler = (cProfile.Profile(), filename or "cpu.prof")
+        RPCCore._profiler[0].enable()
+        return {}
+
+    def unsafe_stop_cpu_profiler(self) -> dict:
+        if RPCCore._profiler is None:
+            raise RPCError(-32000, "profiler not running")
+        prof, filename = RPCCore._profiler
+        RPCCore._profiler = None
+        prof.disable()
+        prof.dump_stats(filename)
+        return {"written": filename}
+
+    def unsafe_write_heap_profile(self, filename: str = "") -> dict:
+        import tracemalloc
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+        filename = filename or "heap.prof"
+        snap = tracemalloc.take_snapshot()
+        with open(filename, "w") as f:
+            for stat in snap.statistics("lineno")[:200]:
+                f.write(f"{stat}\n")
+        return {"written": filename}
 
     # ------------------------------------------------------------------ abci
 

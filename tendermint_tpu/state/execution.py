@@ -129,12 +129,15 @@ class BlockExecutor:
         """state/execution.go:71-119. Returns the new State; raises
         BlockValidationError on an invalid block. `trust_last_commit`:
         see validation.validate_block (fast-sync pre-verified path)."""
+        from tendermint_tpu.utils import fail
         self.validate_block(state, block,
                             trust_last_commit=trust_last_commit)
         responses = exec_block_on_app(self.app_conn, block, state.validators)
+        fail.fail_point("after exec_block")
         if self.state_store is not None:
             self.state_store.save_abci_responses(
                 block.header.height, responses.to_obj())
+        fail.fail_point("after save_abci_responses")
         new_state = update_state(state, block_id, block, responses)
 
         # Commit app + update mempool under the mempool lock
@@ -147,9 +150,11 @@ class BlockExecutor:
         finally:
             self.mempool.unlock()
 
+        fail.fail_point("after app commit + mempool update")
         new_state.app_hash = app_hash
         if self.state_store is not None:
             self.state_store.save(new_state)
+        fail.fail_point("after save_state")
         self.evidence_pool.update(block, new_state)
         if self.event_bus is not None:
             fire_events(self.event_bus, block, block_id, responses)

@@ -1,0 +1,47 @@
+"""Fail-point injection (the reference's fail.Fail() + FAIL_TEST_INDEX,
+consensus/state.go:1179-1228, state/execution.go:82-107).
+
+Each `fail_point()` call increments a process-global counter; when the
+counter reaches $FAIL_TEST_INDEX the process dies hard (os._exit), so
+crash-recovery tests can kill a node at EVERY commit-critical step and
+assert it recovers (test/persist/test_failure_indices.sh's loop)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_lock = threading.Lock()
+_counter = 0
+_callback = None  # test hook: replaces os._exit when set
+
+
+def reset() -> None:
+    global _counter
+    with _lock:
+        _counter = 0
+
+
+def set_callback(cb) -> None:
+    """Testing: call `cb(index)` instead of killing the process."""
+    global _callback
+    _callback = cb
+
+
+def fail_point(name: str = "") -> None:
+    global _counter
+    target = os.environ.get("FAIL_TEST_INDEX")
+    if target is None:
+        return
+    with _lock:
+        _counter += 1
+        current = _counter
+    if current == int(target):
+        if _callback is not None:
+            _callback(current)
+            return
+        sys.stderr.write(f"FAIL_TEST_INDEX {current} hit at "
+                         f"{name or 'unnamed'} — exiting\n")
+        sys.stderr.flush()
+        os._exit(99)
