@@ -15,8 +15,15 @@ Run with the TPU plugin on PYTHONPATH (see .claude/skills/verify): plain
 """
 
 import json
+import os
 import sys
 import time
+
+# persistent XLA compilation cache: the fused pallas kernel costs minutes
+# per shape on remote-compile setups; cache survives process restarts
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
 
 
 def scalar_baseline_rate(pubs, msgs, sigs, budget_s=3.0) -> float:
@@ -66,24 +73,29 @@ def main() -> int:
         msgs.append(m)
         sigs.append(ref.sign(seed, m))
 
-    pk, rb, sbits, hbits, pre = ed25519.prepare_batch(pubs, msgs, sigs)
+    pk, rb, s_bytes, h_bytes, pre = ed25519.prepare_batch_bytes(
+        pubs, msgs, sigs)
     assert pre.all()
     import jax.numpy as jnp
-    args = (jnp.asarray(pk), jnp.asarray(rb),
-            jnp.asarray(sbits), jnp.asarray(hbits))
+    # pad to the pallas tile multiple (512): 10000 -> 10240, 2.4% padding
+    m = ((n + 511) // 512) * 512
+    args = (jnp.asarray(ed25519._pad_to(pk, m)),
+            jnp.asarray(ed25519._pad_to(rb, m)),
+            jnp.asarray(ed25519._pad_to(s_bytes, m)),
+            jnp.asarray(ed25519._pad_to(h_bytes, m)))
 
-    # compile + warmup
-    out = ed25519.verify_kernel_jit(*args)
+    # compile + warmup (fused pallas kernel on TPU, jnp elsewhere)
+    out = ed25519.verify_from_bytes_best(*args)
     out.block_until_ready()
-    assert bool(np.asarray(out).all()), "verification failed"
+    assert bool(np.asarray(out)[:n].all()), "verification failed"
 
     reps = 5
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = ed25519.verify_kernel_jit(*args)
+        out = ed25519.verify_from_bytes_best(*args)
     out.block_until_ready()
     dt = (time.perf_counter() - t0) / reps
-    device_rate = n / dt
+    device_rate = n / dt  # honest: only the n real signatures count
 
     base_rate = scalar_baseline_rate(pubs, msgs, sigs)
 

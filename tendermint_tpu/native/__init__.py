@@ -28,7 +28,9 @@ def _build() -> Optional[str]:
     if os.path.exists(_LIB) and \
             os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
         return _LIB
-    tmp = _LIB + ".tmp"
+    # per-PID tmp: concurrent builders must not interleave writes into
+    # one tmp file (os.replace keeps the install itself atomic)
+    tmp = _LIB + f".{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)

@@ -101,10 +101,17 @@ def select(cond, p, q):
 def select4(idx, pts):
     """Pick pts[idx] (idx int32[...] in 0..3) from 4 candidate points —
     branch-free table lookup used by the Straus double-scalar ladder."""
+    return select_n(idx, pts)
+
+
+def select_n(idx, pts):
+    """Branch-free pts[idx] over any table size. A select is ~20 int32
+    ops per element vs ~16k MACs for one field mul, so even a 16-way
+    lookup is noise next to the point add it feeds."""
     out = []
     for comp in range(4):
         acc = pts[0][comp]
-        for k in (1, 2, 3):
+        for k in range(1, len(pts)):
             acc = fe.select(idx == k, pts[k][comp], acc)
         out.append(acc)
     return tuple(out)
@@ -138,6 +145,77 @@ def decompress(point_bytes):
     x = fe.select(flip, fe.neg(x), x)
     T = fe.mul(x, y)
     return (x, y, one, T), ok
+
+
+def _ec_add_affine_ints(p1, p2):
+    """Host int affine Edwards addition (for precomputed constant tables)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    p, d = fe.P, fe.D_INT
+    k = d * x1 * x2 % p * y1 % p * y2 % p
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + k, p - 2, p) % p
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - k, p - 2, p) % p
+    return (x3, y3)
+
+
+def _b_multiples_ints(n: int = 16):
+    """[(x,y)] for k*B, k = 0..n-1 (k=0 is the identity)."""
+    out = [(0, 1)]
+    for _ in range(n - 1):
+        out.append(_ec_add_affine_ints(out[-1], (BX_INT, BY_INT)))
+    return out
+
+
+_B_MULT_INTS = _b_multiples_ints(16)
+
+
+def _const_point(x: int, y: int, batch_shape):
+    X = jnp.broadcast_to(jnp.asarray(fe.to_limbs(x)),
+                         batch_shape + (fe.NLIMBS,))
+    Y = jnp.broadcast_to(jnp.asarray(fe.to_limbs(y)),
+                         batch_shape + (fe.NLIMBS,))
+    Z = jnp.broadcast_to(jnp.asarray(fe.ONE), batch_shape + (fe.NLIMBS,))
+    T = jnp.broadcast_to(jnp.asarray(fe.to_limbs(x * y % fe.P)),
+                         batch_shape + (fe.NLIMBS,))
+    return (X, Y, Z, T)
+
+
+def scalar_mult_straus_w4(bits_s, bits_h, A_neg):
+    """s*B + h*(-A) with 4-bit windows: 64 iterations of 4 doublings plus
+    TWO table adds — the s*B table is 16 host-precomputed multiples of
+    the fixed base point (constants folded into the program), the
+    h*(-A) table is 16 runtime multiples built once per batch. ~25%
+    fewer field muls than the 1-bit joint ladder (256 adds -> ~142)."""
+    batch_shape = bits_s.shape[:-1]
+
+    # digits[..., w] = 4-bit window w (LE) of the scalar
+    def digits_of(bits):
+        b = bits.reshape(bits.shape[:-1] + (64, 4))
+        return (b[..., 0] + 2 * b[..., 1] + 4 * b[..., 2]
+                + 8 * b[..., 3])
+
+    dig_s = digits_of(bits_s)
+    dig_h = digits_of(bits_h)
+
+    s_table = tuple(_const_point(x, y, batch_shape)
+                    for x, y in _B_MULT_INTS)
+
+    # h table: k * (-A) for k = 0..15 (14 point ops, amortized per batch)
+    ident = identity(batch_shape)
+    h_table = [ident, A_neg]
+    for k in range(2, 16):
+        h_table.append(double(h_table[k // 2]) if k % 2 == 0
+                       else add(h_table[k - 1], A_neg))
+    h_table = tuple(h_table)
+
+    def body(i, acc):
+        w = 63 - i  # MSB-first windows
+        acc = double(double(double(double(acc))))
+        acc = add(acc, select_n(dig_s[..., w], s_table))
+        acc = add(acc, select_n(dig_h[..., w], h_table))
+        return acc
+
+    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))
 
 
 def scalar_mult_straus(bits_s, bits_h, A_neg):

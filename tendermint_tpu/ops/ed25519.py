@@ -45,14 +45,18 @@ def _bits_le(values: np.ndarray) -> np.ndarray:
     return np.unpackbits(values, axis=-1, bitorder="little").astype(np.int32)
 
 
-def prepare_batch(pubkeys, msgs, sigs):
-    """Host prep: returns (pubkeys u8[N,32], R u8[N,32], s_bits i32[N,256],
-    h_bits i32[N,256], precheck bool[N]).
+def prepare_batch_bytes(pubkeys, msgs, sigs):
+    """Host prep, PACKED form: (pubkeys u8[N,32], R u8[N,32],
+    s u8[N,32], h u8[N,32], precheck bool[N]).
+
+    The packed scalars are what crosses the host->device boundary (32
+    bytes each); bit/digit unpacking happens ON DEVICE — shipping
+    pre-unpacked i32[N,256] bit arrays costs 64x the transfer bytes,
+    which dominates end-to-end latency on tunneled links.
 
     precheck is False for malformed inputs (bad lengths, s >= L); such
-    entries still flow through the kernel with zeroed scalars so the batch
-    shape stays static.
-    """
+    entries still flow through the kernel with zeroed scalars so the
+    batch shape stays static."""
     n = len(pubkeys)
     pk = np.zeros((n, 32), np.uint8)
     rb = np.zeros((n, 32), np.uint8)
@@ -73,7 +77,21 @@ def prepare_batch(pubkeys, msgs, sigs):
         s_bytes[i] = np.frombuffer(s.to_bytes(32, "little"), np.uint8)
         h_bytes[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
         pre[i] = True
+    return pk, rb, s_bytes, h_bytes, pre
+
+
+def prepare_batch(pubkeys, msgs, sigs):
+    """Legacy unpacked form: (..., s_bits i32[N,256], h_bits i32[N,256],
+    precheck). Prefer prepare_batch_bytes + the *_from_bytes kernels."""
+    pk, rb, s_bytes, h_bytes, pre = prepare_batch_bytes(pubkeys, msgs, sigs)
     return pk, rb, _bits_le(s_bytes), _bits_le(h_bytes), pre
+
+
+def bits_from_bytes_dev(b_u8):
+    """Device-side unpack: uint8[..., 32] -> int32[..., 256] LE bits."""
+    b = b_u8.astype(jnp.int32)
+    bits = (b[..., :, None] >> jnp.arange(8, dtype=jnp.int32)) & 1
+    return bits.reshape(b.shape[:-1] + (256,))
 
 
 # ---------------------------------------------------------------------------
@@ -90,13 +108,66 @@ def verify_kernel(pubkeys_u8, sig_r_u8, s_bits, h_bits):
     # Zero the scalars of invalid pubkeys so the ladder math stays benign.
     s_bits = jnp.where(ok_a[..., None], s_bits, 0)
     h_bits = jnp.where(ok_a[..., None], h_bits, 0)
-    Q = curve.scalar_mult_straus(s_bits, h_bits, A_neg)
+    Q = curve.scalar_mult_straus_w4(s_bits, h_bits, A_neg)
     enc = curve.encode(Q)
     match = jnp.all(enc == sig_r_u8, axis=-1)
     return ok_a & match
 
 
 verify_kernel_jit = jax.jit(verify_kernel)
+
+
+def _pallas_available() -> bool:
+    """The fused Mosaic kernel needs a real TPU backend."""
+    import os
+    if os.environ.get("TM_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@jax.jit
+def _verify_pallas_jit(pk, rb, sbits, hbits):
+    from tendermint_tpu.ops import ladder_pallas
+    return ladder_pallas.verify_pallas(pk, rb, sbits, hbits)
+
+
+@jax.jit
+def _verify_from_bytes_jnp(pk, rb, s_bytes, h_bytes):
+    return verify_kernel(pk, rb, bits_from_bytes_dev(s_bytes),
+                         bits_from_bytes_dev(h_bytes))
+
+
+@jax.jit
+def _verify_from_bytes_pallas(pk, rb, s_bytes, h_bytes):
+    from tendermint_tpu.ops import ladder_pallas
+    return ladder_pallas.verify_pallas(
+        pk, rb, bits_from_bytes_dev(s_bytes),
+        bits_from_bytes_dev(h_bytes))
+
+
+def verify_from_bytes_best(pk, rb, s_bytes, h_bytes):
+    """Packed-scalar entry point (32B/scalar over the wire; unpack on
+    device). Kernel choice as verify_kernel_best."""
+    n = pk.shape[0]
+    if _pallas_available() and n >= 512 and n % 512 == 0:
+        return _verify_from_bytes_pallas(pk, rb, s_bytes, h_bytes)
+    return _verify_from_bytes_jnp(pk, rb, s_bytes, h_bytes)
+
+
+def verify_kernel_best(pk, rb, sbits, hbits):
+    """Best available device path: the fully-fused pallas kernel on TPU
+    (decompress + Straus-w4 ladder + encode in one VMEM-resident
+    Mosaic program), the jnp kernel elsewhere. The pallas path only
+    takes batches that match its tested tile layout (multiples of the
+    512 tile); small/odd batches go through the jnp kernel — they are
+    the interactive sizes where kernel choice barely matters."""
+    n = pk.shape[0]
+    if _pallas_available() and n >= 512 and n % 512 == 0:
+        return _verify_pallas_jit(pk, rb, sbits, hbits)
+    return verify_kernel_jit(pk, rb, sbits, hbits)
 
 
 # ---------------------------------------------------------------------------
@@ -111,7 +182,11 @@ def _pad_to(x: np.ndarray, n: int) -> np.ndarray:
 
 
 def _bucket(n: int, min_size: int = 8) -> int:
-    """Round batch size up to a power of two to bound jit cache entries."""
+    """Round batch size up to a power of two. This bounds the set of
+    compiled kernel shapes to ~14 total — crucial because each distinct
+    pallas shape costs a full Mosaic compile (minutes on remote-compile
+    setups), which dwarfs the <2x padding compute it avoids. Callers
+    that want zero padding chunk at BATCH_CHUNK first."""
     b = min_size
     while b < n:
         b *= 2
@@ -127,9 +202,15 @@ def verify_batch(pubkeys, msgs, sigs, kernel=None) -> np.ndarray:
     n = len(pubkeys)
     if n == 0:
         return np.zeros(0, np.bool_)
-    pk, rb, sbits, hbits, pre = prepare_batch(pubkeys, msgs, sigs)
+    pk, rb, s_bytes, h_bytes, pre = prepare_batch_bytes(pubkeys, msgs, sigs)
     m = _bucket(n)
-    res = (kernel or verify_kernel_jit)(
-        jnp.asarray(_pad_to(pk, m)), jnp.asarray(_pad_to(rb, m)),
-        jnp.asarray(_pad_to(sbits, m)), jnp.asarray(_pad_to(hbits, m)))
+    args = (jnp.asarray(_pad_to(pk, m)), jnp.asarray(_pad_to(rb, m)),
+            jnp.asarray(_pad_to(s_bytes, m)),
+            jnp.asarray(_pad_to(h_bytes, m)))
+    if kernel is not None:
+        # custom kernels (sharded mesh variants) take unpacked bits
+        res = kernel(args[0], args[1], bits_from_bytes_dev(args[2]),
+                     bits_from_bytes_dev(args[3]))
+    else:
+        res = verify_from_bytes_best(*args)
     return np.asarray(res)[:n] & pre

@@ -1,0 +1,431 @@
+"""Pallas TPU kernel for the Ed25519 double-scalar ladder.
+
+The jnp kernel (ops/curve.py scalar_mult_straus_w4) round-trips every
+field-op result through HBM — at batch 8192 each op moves ~26MB, so the
+ladder is bandwidth-bound at ~24us/sig. This kernel runs the ENTIRE
+64-window ladder inside one pallas_call: the accumulator point, the
+16-entry h-table and all temporaries live in VMEM for all 256 doublings
++ 128 adds, so HBM traffic collapses to the kernel's inputs and outputs.
+
+Layout: field elements are TRANSPOSED to [20 limbs, B] int32 so the batch
+rides the lane dimension (B a multiple of 128) and limb arithmetic is
+sublane-wise. The schoolbook product is 20 shifted block-MACs
+(c[i:i+20] += a[i] * b) instead of a [B,400]x[400,39] contraction —
+identical arithmetic, 20 fused VPU ops, no captured constant matrices
+(pallas kernels cannot close over arrays).
+
+Exactness: limbs < 2^13.3 after every normalize (same invariant and
+proof as ops/field.py); products < 2^26.6, column sums < 20*2^26.6 <
+2^31 — exact in int32 throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tendermint_tpu.ops import field as fe
+from tendermint_tpu.ops import curve
+
+LIMB_BITS = fe.LIMB_BITS
+NLIMBS = fe.NLIMBS
+MASK = fe.MASK
+FOLD = fe.FOLD
+
+DEFAULT_TILE = 512
+
+
+# ---------------------------------------------------------------------------
+# Transposed field ops (value-level, no captured arrays — safe in pallas)
+# ---------------------------------------------------------------------------
+
+def _iota_limbs(b):
+    return jax.lax.broadcasted_iota(jnp.int32, (NLIMBS, b), 0)
+
+
+def _zero_t(b):
+    return jnp.zeros((NLIMBS, b), jnp.int32)
+
+
+def _one_t(b):
+    return jnp.where(_iota_limbs(b) == 0, 1, 0)
+
+
+def _sub_bias_t(b):
+    """The ≡0 (mod p) bias vector of fe._SUB_BIAS, built from iota."""
+    hi = (1 << (LIMB_BITS + 1)) - 2
+    return jnp.where(_iota_limbs(b) == 0, hi - 1214, hi)
+
+
+def _normalize_t(w, passes: int = 4):
+    """Transposed carry propagation: w int32[M, B] columns -> [20, B]
+    limbs (same math as fe._normalize, limb axis first). Static-shape
+    concatenates only — Mosaic has no scatter-add."""
+    for _ in range(passes):
+        c = w >> LIMB_BITS
+        w = w & MASK
+        w = w + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+        c_last = c[-1:]
+        m = w.shape[0]
+        if m > NLIMBS:
+            hi = jnp.concatenate([w[NLIMBS:], c_last], axis=0)
+            pad = NLIMBS - hi.shape[0]
+            if pad > 0:
+                hi = jnp.concatenate(
+                    [hi, jnp.zeros((pad,) + hi.shape[1:], hi.dtype)],
+                    axis=0)
+            w = w[:NLIMBS] + hi * FOLD
+        else:
+            w = w + jnp.concatenate(
+                [c_last * FOLD,
+                 jnp.zeros((m - 1,) + c_last.shape[1:], c_last.dtype)],
+                axis=0)
+    return w
+
+
+def _add_t(a, b):
+    return _normalize_t(a + b, passes=1)
+
+
+def _sub_t(a, b):
+    return _normalize_t(a + _sub_bias_t(a.shape[1]) - b, passes=1)
+
+
+def _mul_t(a, b):
+    """Schoolbook via 20 shifted block-MACs; exact in int32. The shift
+    is expressed as static zero-padding (no scatter in Mosaic)."""
+    bsz = a.shape[1]
+    c = jnp.zeros((2 * NLIMBS - 1, bsz), jnp.int32)
+    for i in range(NLIMBS):
+        prod = a[i][None, :] * b                      # [20, B]
+        parts = []
+        if i > 0:
+            parts.append(jnp.zeros((i, bsz), jnp.int32))
+        parts.append(prod)
+        if NLIMBS - 1 - i > 0:
+            parts.append(jnp.zeros((NLIMBS - 1 - i, bsz), jnp.int32))
+        c = c + (parts[0] if len(parts) == 1
+                 else jnp.concatenate(parts, axis=0))
+    return _normalize_t(c)
+
+
+def _mul_small_t(a, k: int):
+    return _normalize_t(a * k, passes=3)
+
+
+def _square_t(a):
+    return _mul_t(a, a)
+
+
+# ---------------------------------------------------------------------------
+# Transposed point ops (X, Y, Z, T) each int32[20, B]
+# ---------------------------------------------------------------------------
+
+def _pt_identity(b):
+    return (_zero_t(b), _one_t(b), _one_t(b), _zero_t(b))
+
+
+def _pt_add(p, q, d2):
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = _mul_t(_sub_t(Y1, X1), _sub_t(Y2, X2))
+    B = _mul_t(_add_t(Y1, X1), _add_t(Y2, X2))
+    C = _mul_t(_mul_t(T1, d2), T2)
+    Dv = _mul_small_t(_mul_t(Z1, Z2), 2)
+    E = _sub_t(B, A)
+    F = _sub_t(Dv, C)
+    G = _add_t(Dv, C)
+    H = _add_t(B, A)
+    return (_mul_t(E, F), _mul_t(G, H), _mul_t(F, G), _mul_t(E, H))
+
+
+def _pt_double(p):
+    X1, Y1, Z1, _ = p
+    A = _square_t(X1)
+    B = _square_t(Y1)
+    C = _mul_small_t(_square_t(Z1), 2)
+    E = _sub_t(_sub_t(_square_t(_add_t(X1, Y1)), A), B)
+    G = _sub_t(B, A)
+    F = _sub_t(G, C)
+    H = _sub_t(_sub_t(_zero_t(A.shape[1]), A), B)
+    return (_mul_t(E, F), _mul_t(G, H), _mul_t(F, G), _mul_t(E, H))
+
+
+def _pt_select(idx, pts):
+    """pts[idx] over a python list of points; idx int32[B]."""
+    out = []
+    for comp in range(4):
+        acc = pts[0][comp]
+        for k in range(1, len(pts)):
+            acc = jnp.where((idx == k)[None, :], pts[k][comp], acc)
+        out.append(acc)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Transposed byte/bit packing + canonical reduction
+# ---------------------------------------------------------------------------
+
+def _from_bytes_t(b_i32):
+    """int32[32, B] little-endian bytes -> (limbs int32[20, B], high bit
+    int32[B]). Mirrors fe.from_bytes (high bit masked off)."""
+    bsz = b_i32.shape[1]
+    high = (b_i32[31] >> 7) & 1
+    b = jnp.concatenate([b_i32[:31], (b_i32[31] & 0x7F)[None, :]], axis=0)
+    limbs = []
+    for k in range(NLIMBS):
+        lo_bit = 13 * k
+        acc = jnp.zeros((bsz,), jnp.int32)
+        for byte in range(lo_bit // 8, min(32, (lo_bit + 12) // 8 + 1)):
+            shift = byte * 8 - lo_bit
+            v = b[byte]
+            acc = acc + (jnp.left_shift(v, shift) if shift >= 0
+                         else jnp.right_shift(v, -shift))
+        limbs.append(acc & MASK)
+    return jnp.stack(limbs, axis=0), high
+
+
+def _canonical_t(x):
+    """Transposed port of fe.canonical: fully reduce below p."""
+    cols = [x[k] for k in range(NLIMBS)]
+    for _ in range(2):
+        hi = cols[NLIMBS - 1] >> 8
+        cols[NLIMBS - 1] = cols[NLIMBS - 1] & 0xFF
+        cols[0] = cols[0] + 19 * hi
+        carry = None
+        out = []
+        for k in range(NLIMBS):
+            t = cols[k] if carry is None else cols[k] + carry
+            out.append(t & MASK)
+            carry = t >> LIMB_BITS
+        cols = out
+        cols[NLIMBS - 1] = cols[NLIMBS - 1] + (carry << LIMB_BITS)
+    p_limbs = [int(v) for v in fe.P_LIMBS]
+    borrow = jnp.zeros_like(cols[0])
+    outs = []
+    for k in range(NLIMBS):
+        t = cols[k] - p_limbs[k] + borrow
+        outs.append(t & MASK)
+        borrow = t >> LIMB_BITS
+    ge_p = borrow == 0
+    return [jnp.where(ge_p, outs[k], cols[k]) for k in range(NLIMBS)]
+
+
+def _to_bytes_t(x):
+    """Canonical LE bytes: [20, B] -> int32[32, B]."""
+    cols = _canonical_t(x)
+    out = []
+    for byte in range(32):
+        lo_bit = byte * 8
+        acc = jnp.zeros_like(cols[0])
+        for k in range(NLIMBS):
+            kb = 13 * k
+            if kb + 13 <= lo_bit or kb >= lo_bit + 8:
+                continue
+            shift = kb - lo_bit
+            v = cols[k]
+            acc = acc + (jnp.left_shift(v, shift) if shift >= 0
+                         else jnp.right_shift(v, -shift))
+        out.append(acc & 0xFF)
+    return jnp.stack(out, axis=0)
+
+
+def _pow_bits_t(x, bits_ref, nbits):
+    """x**e for a static exponent whose MSB-first bits live in bits_ref
+    (int32[nbits]). fori_loop square-and-multiply."""
+    one = _one_t(x.shape[1])
+
+    def body(i, acc):
+        acc = _square_t(acc)
+        bit = bits_ref[i]  # scalar SMEM load
+        acc_mul = _mul_t(acc, x)
+        return jnp.where(bit == 1, acc_mul, acc)
+
+    return jax.lax.fori_loop(0, nbits, body, one)
+
+
+# ---------------------------------------------------------------------------
+# The fused verify kernel: decompress + ladder + encode + compare, all VMEM
+# ---------------------------------------------------------------------------
+
+def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
+                   d_ref, d2_ref, sqrt_m1_ref,
+                   p58_bits_ref, pm2_bits_ref, out_ref, an_scratch):
+    """out[B] = 1 iff the signature verifies.
+
+    pk, rb:      int32[32, B] pubkey / signature-R bytes.
+    dig_s/dig_h: int32[64, B] 4-bit scalar windows.
+    s_table:     int32[16, 4, 20] k*B constants.
+    consts:      int32[4, 20]: D, D2, SQRT_M1, ONE(unused spare).
+    p58_bits:    int32[n58] MSB-first bits of (p-5)/8.
+    pm2_bits:    int32[n2]  MSB-first bits of p-2.
+    """
+    bsz = pk_ref.shape[-1]
+
+    def cvec(ref):
+        return jnp.broadcast_to(ref[:][:, None], (NLIMBS, bsz))
+
+    d_c, d2, sqrt_m1 = cvec(d_ref), cvec(d2_ref), cvec(sqrt_m1_ref)
+
+    # ---- decompress A (curve.decompress, transposed)
+    y, sign = _from_bytes_t(pk_ref[:])
+    one = _one_t(bsz)
+    y2 = _square_t(y)
+    u = _sub_t(y2, one)
+    v = _add_t(_mul_t(y2, d_c), one)
+    # sqrt_ratio
+    v3 = _mul_t(_square_t(v), v)
+    v7 = _mul_t(_square_t(v3), v)
+    n58 = p58_bits_ref.shape[0]
+    r = _mul_t(_mul_t(u, v3),
+               _pow_bits_t(_mul_t(u, v7), p58_bits_ref, n58))
+    check = _mul_t(v, _square_t(r))
+    u_bytes = _to_bytes_t(u)
+    neg_u_bytes = _to_bytes_t(_sub_t(_zero_t(bsz), u))
+    check_bytes = _to_bytes_t(check)
+    ok_direct = jnp.all(check_bytes == u_bytes, axis=0)
+    ok_flipped = jnp.all(check_bytes == neg_u_bytes, axis=0)
+    x = jnp.where((ok_flipped & ~ok_direct)[None, :],
+                  _mul_t(r, sqrt_m1), r)
+    ok = ok_direct | ok_flipped
+    x_bytes = _to_bytes_t(x)
+    x_is_zero = jnp.all(x_bytes == 0, axis=0)
+    ok = ok & ~(x_is_zero & (sign == 1))
+    x_odd = (x_bytes[0] & 1) == 1
+    flip = x_odd != (sign == 1)
+    x = jnp.where(flip[None, :], _sub_t(_zero_t(bsz), x), x)
+    # -A directly (negate x, t). Materialize through VMEM scratch:
+    # feeding computed values straight into the table build trips a
+    # Mosaic layout assert ("limits[i] <= dim(i)"); a ref round-trip
+    # matches the layout the loop expects.
+    xn = _sub_t(_zero_t(bsz), x)
+    an_scratch[0] = xn
+    an_scratch[1] = y
+    an_scratch[2] = one
+    an_scratch[3] = _mul_t(xn, y)
+    a_neg = tuple(an_scratch[c] for c in range(4))
+
+    # build tables
+    h_table = [_pt_identity(bsz), a_neg]
+    for k in range(2, 16):
+        h_table.append(_pt_double(h_table[k // 2]) if k % 2 == 0
+                       else _pt_add(h_table[k - 1], a_neg, d2))
+    s_table = []
+    for k in range(16):
+        s_table.append(tuple(
+            jnp.broadcast_to(s_table_ref[k, c][:, None], (NLIMBS, bsz))
+            for c in range(4)))
+
+    def body(i, acc):
+        w = 63 - i
+        ds_w = jnp.where(ok, dig_s_ref[pl.ds(w, 1), :][0], 0)
+        dh_w = jnp.where(ok, dig_h_ref[pl.ds(w, 1), :][0], 0)
+        acc = _pt_double(_pt_double(_pt_double(_pt_double(acc))))
+        acc = _pt_add(acc, _pt_select(ds_w, s_table), d2)
+        acc = _pt_add(acc, _pt_select(dh_w, h_table), d2)
+        return acc
+
+    X, Y, Z, _ = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz))
+
+    # ---- encode result + compare with R (curve.encode, transposed)
+    n2 = pm2_bits_ref.shape[0]
+    zi = _pow_bits_t(Z, pm2_bits_ref, n2)
+    xa = _mul_t(X, zi)
+    ya = _mul_t(Y, zi)
+    by = _to_bytes_t(ya)
+    sign_bit = _to_bytes_t(xa)[0] & 1
+    top = by[31] | (sign_bit << 7)
+    enc = jnp.concatenate([by[:31], top[None, :]], axis=0)
+    match = jnp.all(enc == rb_ref[:], axis=0)
+    out_ref[0, :] = (ok & match).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _consts_np():
+    out = np.zeros((4, NLIMBS), np.int32)
+    out[0] = fe.D
+    out[1] = fe.D2
+    out[2] = fe.SQRT_M1
+    out[3] = fe.ONE
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _exp_bits_np(exp: int):
+    return np.array([(exp >> i) & 1
+                     for i in reversed(range(exp.bit_length()))], np.int32)
+
+
+def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
+                  interpret: bool = False):
+    """Fully-fused device verification: bool[N] verdicts.
+
+    Same contract as ed25519.verify_kernel; the whole pipeline
+    (decompress -> Straus-w4 ladder -> encode -> compare) runs inside one
+    pallas_call with every intermediate in VMEM. `interpret=True` runs
+    the kernel in the pallas interpreter (CPU differential testing)."""
+    n = pk_u8.shape[0]
+    tile = min(tile, n)
+    assert n % tile == 0, (n, tile)
+
+    pk_t = pk_u8.astype(jnp.int32).T                  # [32, N]
+    rb_t = rb_u8.astype(jnp.int32).T
+    dig_s = _digits4_t(s_bits)
+    dig_h = _digits4_t(h_bits)
+
+    out = pl.pallas_call(
+        _verify_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(n // tile,),
+            in_specs=[
+                pl.BlockSpec((32, tile), lambda i: (0, i)),
+                pl.BlockSpec((32, tile), lambda i: (0, i)),
+                pl.BlockSpec((64, tile), lambda i: (0, i)),
+                pl.BlockSpec((64, tile), lambda i: (0, i)),
+                pl.BlockSpec((16, 4, NLIMBS), lambda i: (0, 0, 0)),
+                pl.BlockSpec((NLIMBS,), lambda i: (0,)),
+                pl.BlockSpec((NLIMBS,), lambda i: (0,)),
+                pl.BlockSpec((NLIMBS,), lambda i: (0,)),
+                # exponent bit vectors: scalar dynamic reads -> SMEM
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+            scratch_shapes=[pltpu.VMEM((4, NLIMBS, tile), jnp.int32)],
+        ),
+        interpret=interpret,
+    )(pk_t, rb_t, dig_s, dig_h, jnp.asarray(_s_table_np()),
+      jnp.asarray(fe.D), jnp.asarray(fe.D2), jnp.asarray(fe.SQRT_M1),
+      jnp.asarray(_exp_bits_np((fe.P - 5) // 8)),
+      jnp.asarray(_exp_bits_np(fe.P - 2)))
+    return out[0].astype(jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# Host-precomputed tables + digit packing
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _s_table_np():
+    out = np.zeros((16, 4, NLIMBS), np.int32)
+    for k, (x, y) in enumerate(curve._B_MULT_INTS):
+        out[k, 0] = fe.to_limbs(x)
+        out[k, 1] = fe.to_limbs(y)
+        out[k, 2] = fe.to_limbs(1)
+        out[k, 3] = fe.to_limbs(x * y % fe.P)
+    return out
+
+
+def _digits4_t(bits):
+    """int32[..., 256] LE bits -> transposed digits int32[64, B]."""
+    b = bits.reshape(bits.shape[:-1] + (64, 4))
+    d = b[..., 0] + 2 * b[..., 1] + 4 * b[..., 2] + 8 * b[..., 3]
+    return d.T  # [64, B]

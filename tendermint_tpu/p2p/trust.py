@@ -88,10 +88,15 @@ class TrustMetric:
 
     def to_obj(self) -> dict:
         with self._lock:
-            # fold the open interval in so persisted state is complete
-            return {"interval_s": self.interval_s,
-                    "history": [self._current_ratio()] +
-                               self.history[:MAX_HISTORY - 1]}
+            # fold the open interval in ONLY if it saw events — an empty
+            # interval would persist a synthetic 1.0 entry, and repeated
+            # save/restart cycles would launder a bad peer's history
+            if self.good + self.bad > 0:
+                history = [self._current_ratio()] + \
+                    self.history[:MAX_HISTORY - 1]
+            else:
+                history = list(self.history)
+            return {"interval_s": self.interval_s, "history": history}
 
     @classmethod
     def from_obj(cls, o: dict) -> "TrustMetric":

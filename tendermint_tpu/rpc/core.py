@@ -72,6 +72,8 @@ class RPCEnv:
 class RPCCore:
     def __init__(self, env: RPCEnv):
         self.env = env
+        self._profiler = None
+        self._profiler_lock = threading.Lock()
 
     def routes(self) -> Dict[str, Any]:
         """rpc/core/routes.go:8-37 (+ unsafe :39-50)."""
@@ -304,31 +306,36 @@ class RPCCore:
         return {}
 
     # profiling (rpc/core/dev.go:23-43; cProfile/tracemalloc instead of
-    # Go's pprof)
-
-    _profiler = None
+    # Go's pprof). Per-instance state + lock: multiple in-process nodes
+    # each own their profiler, and concurrent starts cannot double-enable.
 
     def unsafe_start_cpu_profiler(self, filename: str = "") -> dict:
         import cProfile
-        if RPCCore._profiler is not None:
-            raise RPCError(-32000, "profiler already running")
-        RPCCore._profiler = (cProfile.Profile(), filename or "cpu.prof")
-        RPCCore._profiler[0].enable()
+        with self._profiler_lock:
+            if self._profiler is not None:
+                raise RPCError(-32000, "profiler already running")
+            self._profiler = (cProfile.Profile(), filename or "cpu.prof")
+            self._profiler[0].enable()
         return {}
 
     def unsafe_stop_cpu_profiler(self) -> dict:
-        if RPCCore._profiler is None:
-            raise RPCError(-32000, "profiler not running")
-        prof, filename = RPCCore._profiler
-        RPCCore._profiler = None
+        with self._profiler_lock:
+            if self._profiler is None:
+                raise RPCError(-32000, "profiler not running")
+            prof, filename = self._profiler
+            self._profiler = None
         prof.disable()
         prof.dump_stats(filename)
         return {"written": filename}
 
     def unsafe_write_heap_profile(self, filename: str = "") -> dict:
+        """First call arms tracemalloc and returns started=true (there is
+        nothing to snapshot yet); later calls write the snapshot."""
         import tracemalloc
         if not tracemalloc.is_tracing():
             tracemalloc.start()
+            return {"started": True,
+                    "note": "tracemalloc armed; call again to snapshot"}
         filename = filename or "heap.prof"
         snap = tracemalloc.take_snapshot()
         with open(filename, "w") as f:
