@@ -74,6 +74,8 @@ class BlockPool:
                  on_peer_error: Callable[[str, str], None]):
         """send_request(peer_id, height) -> sent ok;
         on_peer_error(peer_id, reason) drops the peer at the switch."""
+        from tendermint_tpu.utils.log import get_logger
+        self.logger = get_logger("blockchain")
         self.height = start_height           # next height to sync
         self.send_request = send_request
         self.on_peer_error = on_peer_error
@@ -173,6 +175,8 @@ class BlockPool:
                     req.peer_id = ""
                     req.sent_at = now
         for peer_id, reason in drop:
+            self.logger.info("evicting fast-sync peer", peer=peer_id,
+                             reason=reason)
             self.remove_peer(peer_id)
             self.on_peer_error(peer_id, reason)
         self.make_next_requests()

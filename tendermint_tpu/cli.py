@@ -41,6 +41,9 @@ def cmd_node(args) -> int:
     single-process validator."""
     from tendermint_tpu.node import default_node
     from tendermint_tpu.abci.apps import CounterApp, KVStoreApp
+    from tendermint_tpu.config import default_config
+    from tendermint_tpu.utils.log import setup_logging
+    setup_logging(default_config(args.home).base.log_level)
     app = {"kvstore": KVStoreApp, "counter": CounterApp}[args.app]()
     node = default_node(args.home, app=app, with_p2p=args.p2p,
                         fast_sync=(args.fast_sync if args.p2p else False))

@@ -49,6 +49,8 @@ class ConsensusState:
                  mempool=None, evidence_pool=None,
                  priv_validator=None, wal=None, event_bus=None,
                  ticker_factory=TimeoutTicker):
+        from tendermint_tpu.utils.log import get_logger
+        self.logger = get_logger("consensus")
         self.config = config
         self.state = state             # last committed State
         self.block_exec = block_exec
@@ -151,7 +153,8 @@ class ConsensusState:
             hook(msg)
 
     def _log(self, s: str) -> None:
-        pass  # hooked by node logging
+        self.logger.error(s, height=self.rs.height, round=self.rs.round,
+                          step=self.rs.step.name)
 
     def _publish(self, event: str, extra: Optional[dict] = None) -> None:
         if self.event_bus is not None and not self.replay_mode:
@@ -286,6 +289,8 @@ class ConsensusState:
             rs.proposal_block = None
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)  # room for round-skip votes
+        self.logger.info("entering new round", height=height, round=round_,
+                         proposer=rs.validators.proposer().address)
         self._publish("NewRound")
 
         wait_for_txs = (not self.config.create_empty_blocks and round_ == 0
@@ -569,6 +574,9 @@ class ConsensusState:
             raise ConsensusFailure("parts header != commit header")
         if block.hash() != maj.hash:
             raise ConsensusFailure("block hash != commit hash")
+        self.logger.info("finalizing commit", height=height,
+                         hash=block.hash(), round=rs.commit_round,
+                         txs=len(block.data.txs))
         try:
             self.block_exec.validate_block(self.state, block)
         except BlockValidationError as e:

@@ -37,10 +37,12 @@ class EvidencePool:
     def add_evidence(self, ev) -> None:
         """Verify + store + enqueue for gossip (evidence/pool.go:87).
         Raises BlockValidationError on invalid evidence; silently ignores
-        duplicates."""
+        duplicates and already-committed evidence (after a block commits
+        evidence, honest peers' in-flight broadcasts of it are a normal
+        race, not misbehavior)."""
         with self._lock:
             if self.store.is_committed(ev):
-                raise BlockValidationError("evidence already committed")
+                return
             val = verify_evidence(self.state, ev, self.state_store,
                                   verifier=self.verifier)
             priority = val.voting_power if val is not None else 0

@@ -11,7 +11,8 @@ import threading
 from tendermint_tpu.evidence.pool import EvidencePool
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.p2p.conn import ChannelDescriptor
-from tendermint_tpu.state.validation import BlockValidationError
+from tendermint_tpu.state.validation import (BlockValidationError,
+                                             EvidenceTooOldError)
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.evidence import evidence_from_obj, evidence_to_obj
 
@@ -62,6 +63,8 @@ class EvidenceReactor(Reactor):
                 return
             try:
                 self.pool.add_evidence(ev)
+            except EvidenceTooOldError:
+                continue  # gossip race, not misbehavior
             except BlockValidationError:
                 if self.switch is not None:
                     self.switch.stop_peer_for_error(

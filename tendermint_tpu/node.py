@@ -35,6 +35,11 @@ class Node:
                  priv_validator=None, app=None, client_creator=None,
                  mempool=None, evidence_pool=None, in_memory=False,
                  with_p2p=False, fast_sync=False, with_rpc=False):
+        from tendermint_tpu.utils.log import get_logger
+        # logging is configured once at the CLI entry point; constructing
+        # a Node (tests build several in-process) must not reconfigure
+        # the process-global handler/levels
+        self.logger = get_logger("node")
         self.config = config
         self.gen_doc = gen_doc
 
@@ -177,6 +182,10 @@ class Node:
             self.switch.addr_book = self.addr_book
 
     def start(self) -> None:
+        self.logger.info("starting node",
+                         chain_id=self.gen_doc.chain_id,
+                         height=self.consensus.state.last_block_height,
+                         fast_sync=self.fast_sync)
         # WAL catchup for the in-flight height (consensus/replay.go:93).
         # In fast-sync mode the consensus reactor replays at
         # switch_to_consensus instead — replaying now would be wiped by

@@ -141,6 +141,10 @@ class SecretConnection:
         sealed = _read_exact(self.conn, clen)
         plain = self._recv.open(sealed)
         (dlen,) = struct.unpack(">H", plain[:2])
+        if 2 + dlen > len(plain):
+            raise ValueError(
+                f"secret frame length {dlen} exceeds plaintext "
+                f"({len(plain) - 2} data bytes)")
         return plain[2:2 + dlen]
 
     def close(self) -> None:

@@ -162,8 +162,11 @@ class PEXReactor(Reactor):
             need -= 1
             if need <= 0:
                 break
-        # still hungry: ask a random connected peer for more addrs
+        # still hungry: ask a random OUTBOUND peer for more addrs.
+        # Soliciting inbound peers would arm _requests_sent for an
+        # attacker-chosen connection, letting it seed the addr book
+        # (eclipse surface) — outbound dials are ones we picked.
         if self.book.need_more_addrs():
-            peers = self.switch.peers.list()
+            peers = [p for p in self.switch.peers.list() if p.outbound]
             if peers:
                 self._request_addrs(random.choice(peers))

@@ -44,6 +44,8 @@ class SwitchError(Exception):
 class Switch:
     def __init__(self, config, node_key: NodeKey, node_info: NodeInfo,
                  encrypt: bool = True):
+        from tendermint_tpu.utils.log import get_logger
+        self.logger = get_logger("p2p")
         self.config = config
         self.node_key = node_key
         self.node_info = node_info
@@ -291,6 +293,8 @@ class Switch:
 
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """switch.go StopPeerForError + reconnect for persistent peers."""
+        self.logger.error("stopping peer for error", peer=peer.id,
+                          err=reason)
         if self.trust_store is not None:
             self.trust_store.get_metric(peer.id).bad_events(1)
         self._remove_peer(peer, reason)

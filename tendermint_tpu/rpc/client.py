@@ -16,7 +16,8 @@ import queue
 import socket
 import struct
 import threading
-from typing import Any, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 from urllib.parse import urlencode
 from urllib.request import Request, urlopen
 
@@ -221,3 +222,59 @@ class LocalClient:
     def call(self, method: str, **params) -> Any:
         from tendermint_tpu.rpc.core import jsonify
         return jsonify(self.server.call(method, params))
+
+
+@dataclass
+class Call:
+    """One recorded RPC invocation (rpc/client/mock/client.go Call)."""
+    method: str
+    params: Dict[str, Any]
+    response: Any = None
+    error: Optional[Exception] = None
+
+
+class MockClient:
+    """Recording/canned-response client (rpc/client/mock/client.go:135).
+
+    Two modes, combinable per method:
+      * canned: `expect(method, response=... | error=...)` queues what the
+        next call of `method` returns;
+      * passthrough: constructed with an inner client (Local/JSONRPC),
+        un-canned methods are forwarded.
+    Every invocation is recorded in `.calls` for assertions.
+    """
+
+    def __init__(self, inner=None):
+        self.inner = inner
+        self.calls: List[Call] = []
+        self._canned: Dict[str, List[Call]] = {}
+
+    def expect(self, method: str, response: Any = None,
+               error: Optional[Exception] = None) -> None:
+        self._canned.setdefault(method, []).append(
+            Call(method, {}, response, error))
+
+    def call(self, method: str, **params) -> Any:
+        queued = self._canned.get(method)
+        if queued:
+            canned = queued.pop(0)
+            rec = Call(method, params, canned.response, canned.error)
+            self.calls.append(rec)
+            if canned.error is not None:
+                raise canned.error
+            return canned.response
+        if self.inner is None:
+            err = RPCClientError(-32601, f"no canned response and no "
+                                 f"inner client for {method!r}")
+            self.calls.append(Call(method, params, None, err))
+            raise err
+        try:
+            resp = self.inner.call(method, **params)
+        except Exception as e:
+            self.calls.append(Call(method, params, None, e))
+            raise
+        self.calls.append(Call(method, params, resp, None))
+        return resp
+
+    def calls_to(self, method: str) -> List[Call]:
+        return [c for c in self.calls if c.method == method]

@@ -83,6 +83,7 @@ class RPCCore:
             "blockchain": self.blockchain,
             "genesis": self.genesis,
             "block": self.block,
+            "block_results": self.block_results,
             "commit": self.commit,
             "validators": self.validators,
             "dump_consensus_state": self.dump_consensus_state,
@@ -98,6 +99,7 @@ class RPCCore:
         }
         if self.env.unsafe:
             r.update({
+                "dial_seeds": self.dial_seeds,
                 "dial_peers": self.dial_peers,
                 "unsafe_flush_mempool": self.unsafe_flush_mempool,
                 "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
@@ -200,6 +202,22 @@ class RPCCore:
             raise RPCError(-32000, f"no block at height {height}")
         return jsonify({"block_meta": meta.to_obj() if meta else None,
                         "block": blk.to_obj()})
+
+    def block_results(self, height: int = 0) -> dict:
+        """rpc/core/blocks.go:332 BlockResults: the ABCI responses
+        (DeliverTx results + EndBlock) persisted for `height` by the
+        state store (state/store.go:127)."""
+        store = self.env.block_store
+        h = store.height()
+        if height <= 0:
+            height = h
+        if height > h or height < 1:
+            raise RPCError(-32000,
+                           f"height {height} must be in [1, {h}]")
+        results = self.env.state_store.load_abci_responses(height)
+        if results is None:
+            raise RPCError(-32000, f"no results for height {height}")
+        return jsonify({"height": height, "results": results})
 
     def commit(self, height: int = 0) -> dict:
         """rpc/core/blocks.go:278: height's commit; the canonical commit
@@ -398,6 +416,12 @@ class RPCCore:
                  for p in peers.split(",") if p]
         self.env.switch.dial_peers_async(addrs, persistent=persistent)
         return {"dialed": [str(a) for a in addrs]}
+
+    def dial_seeds(self, seeds: str = "") -> dict:
+        """rpc/core/routes.go:41 unsafe_dial_seeds: one-shot dials into
+        the topology, non-persistent."""
+        return {"dialed": self.dial_peers(seeds)["dialed"],
+                "log": "dialing seeds in rounds"}
 
     # ---------------------------------------------------------------- events
 

@@ -32,6 +32,13 @@ from urllib.parse import parse_qsl, urlparse
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
+# Upper bound on any buffered client input (one WebSocket message across
+# fragments, or one HTTP POST body). The server binds non-loopback
+# addresses, so unbounded client-declared lengths are a remote
+# memory-exhaustion vector; anything legitimate (txs, queries) fits well
+# under 1 MB.
+MAX_BODY_BYTES = 1 << 20
+
 
 class RPCError(Exception):
     def __init__(self, code: int, message: str, data=None):
@@ -136,8 +143,10 @@ class WSConn:
 
     def recv_message(self) -> Optional[str]:
         """One text message (handles fragmentation + control frames);
-        None on close."""
+        None on close. Connections declaring frames/messages larger than
+        MAX_BODY_BYTES are closed before buffering the payload."""
         parts = []
+        total = 0
         while True:
             hdr = self._read_exact(2)
             if hdr is None:
@@ -156,6 +165,11 @@ class WSConn:
                 if ext is None:
                     return None
                 (n,) = struct.unpack(">Q", ext)
+            if opcode in (0x1, 0x2, 0x0):
+                total += n
+            if n > MAX_BODY_BYTES or total > MAX_BODY_BYTES:
+                self.close()
+                return None
             mask = self._read_exact(4) if masked else b"\x00" * 4
             if mask is None:
                 return None
@@ -270,6 +284,11 @@ class RPCServer:
             def do_POST(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
+                    if not (0 <= n <= MAX_BODY_BYTES):
+                        self._reply(_rpc_response(None, error=RPCError(
+                            -32600, "request body too large")), 413)
+                        self.close_connection = True
+                        return
                     req = json.loads(self.rfile.read(n) or b"{}")
                 except Exception:
                     self._reply(_rpc_response(

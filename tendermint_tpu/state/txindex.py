@@ -15,6 +15,16 @@ _HASH_PREFIX = b"txhash/"
 _TAG_PREFIX = b"txtag/"
 
 
+def _esc(s: str) -> str:
+    """Escape the key separator in app-supplied tag names/values so a
+    '/' inside a value cannot shift the tag/value/height/index fields."""
+    return s.replace("%", "%25").replace("/", "%2F")
+
+
+def _unesc(s: str) -> str:
+    return s.replace("%2F", "/").replace("%25", "%")
+
+
 class NullTxIndexer:
     """state/txindex/null — indexing disabled."""
 
@@ -56,7 +66,7 @@ class KVTxIndexer:
                 if not self._should_index(tag):
                     continue
                 key = _TAG_PREFIX + (
-                    f"{tag}/{_orderable(val)}/"
+                    f"{_esc(tag)}/{_esc(_orderable(val))}/"
                     f"{e['height']:016d}/{e['index']:08d}").encode()
                 pairs.append((key, tx_hash.hex().encode()))
             # always range-queryable by height (reserved tag tx.height)
@@ -100,9 +110,9 @@ class KVTxIndexer:
 
     def _match_condition(self, tag: str, op: str, val: str) -> set:
         hashes = set()
-        prefix = _TAG_PREFIX + f"{tag}/".encode()
+        prefix = _TAG_PREFIX + f"{_esc(tag)}/".encode()
         for key, stored in self.db.iterate(prefix):
-            tag_val = key[len(prefix):].split(b"/")[0].decode()
+            tag_val = _unesc(key[len(prefix):].split(b"/")[0].decode())
             if _cmp(tag_val, op, val):
                 hashes.add(stored.decode())
         return hashes

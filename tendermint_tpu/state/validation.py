@@ -17,6 +17,12 @@ class BlockValidationError(Exception):
     pass
 
 
+class EvidenceTooOldError(BlockValidationError):
+    """Evidence aged past the window — a normal gossip race, not an
+    attack; peers relaying it are not punished."""
+    pass
+
+
 def validate_block(state: State, block: Block, state_store=None,
                    verifier=None, trust_last_commit: bool = False) -> None:
     """state/validation.go:15-122.
@@ -86,7 +92,7 @@ def verify_evidence(state: State, evidence, state_store=None,
     ev_height = evidence.height()
     max_age = state.consensus_params.evidence.max_age
     if ev_height < 1 or height - ev_height > max_age:
-        raise BlockValidationError(
+        raise EvidenceTooOldError(
             f"evidence from height {ev_height} is too old (block {height}, "
             f"max age {max_age})")
     if ev_height > height:

@@ -27,7 +27,12 @@ class Validator:
 
     @property
     def address(self) -> bytes:
-        return address_of(self.pubkey)
+        # cached: proposer rotation compares addresses O(V) times per
+        # height and hashing the pubkey each time dominated the loop
+        if self.__dict__.get("_addr_pk") is not self.pubkey:
+            self.__dict__["_addr"] = address_of(self.pubkey)
+            self.__dict__["_addr_pk"] = self.pubkey
+        return self.__dict__["_addr"]
 
     def copy(self) -> "Validator":
         return Validator(self.pubkey, self.voting_power, self.accum)
@@ -60,6 +65,12 @@ class ValidatorSet:
         addrs = [v.address for v in self.validators]
         if len(set(addrs)) != len(addrs):
             raise ValueError("duplicate validator address")
+        # addr -> index map: the reference binary-searches its sorted
+        # array (types/validator_set.go:93-101); lookups here are per
+        # vote on the Python hot path, so O(1) beats O(log V). The
+        # ordering never changes after construction (updates build a
+        # new set), so the map cannot go stale.
+        self._index = {a: i for i, a in enumerate(addrs)}
         self._proposer: Optional[Validator] = None
 
     def __len__(self) -> int:
@@ -74,10 +85,8 @@ class ValidatorSet:
         return sum(v.voting_power for v in self.validators)
 
     def get_by_address(self, addr: bytes):
-        for i, v in enumerate(self.validators):
-            if v.address == addr:
-                return i, v
-        return -1, None
+        i = self._index.get(addr, -1)
+        return (i, self.validators[i]) if i >= 0 else (-1, None)
 
     def get_by_index(self, i: int) -> Optional[Validator]:
         return self.validators[i] if 0 <= i < len(self.validators) else None

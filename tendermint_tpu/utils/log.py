@@ -1,0 +1,147 @@
+"""Structured key-value logging (tmlibs/log equivalent).
+
+The reference wires a go-kit style logger through every service with
+per-module level filtering (node/node.go:162-263 `logger.With("module",
+...)`; config/config.go:114 `log_level` strings like
+"state:info,p2p:error,*:debug"). This is the same surface on stdlib
+logging:
+
+    log = get_logger("consensus").with_fields(height=5)
+    log.info("entering new round", round=0)
+    # => I[2026-07-30|06:10:01.123] entering new round  module=consensus height=5 round=0
+
+Levels: debug/info/error (the reference's three). setup_logging() parses
+the reference's comma-separated module:level spec; `*` sets the default.
+All loggers live under the "tm" root so application logging is
+unaffected.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+_ROOT = "tm"
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "error": logging.ERROR, "none": logging.CRITICAL + 10}
+
+_setup_lock = threading.Lock()
+_configured = False
+
+
+class KVFormatter(logging.Formatter):
+    """go-kit terminal style: level char, timestamp, message, k=v pairs."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        lvl = {"DEBUG": "D", "INFO": "I", "ERROR": "E"}.get(
+            record.levelname, record.levelname[:1])
+        ts = self.formatTime(record, "%Y-%m-%d|%H:%M:%S")
+        msg = record.getMessage()
+        fields: Dict[str, Any] = {"module": record.name.split(".", 1)[-1]
+                                  if "." in record.name else record.name}
+        fields.update(getattr(record, "kv", None) or {})
+        kvs = " ".join(f"{k}={_render(v)}" for k, v in fields.items())
+        out = f"{lvl}[{ts}.{int(record.msecs):03d}] {msg:<44} {kvs}"
+        if record.exc_info:
+            out += "\n" + self.formatException(record.exc_info)
+        return out
+
+
+def _render(v: Any) -> str:
+    if isinstance(v, bytes):
+        return v.hex()[:16]
+    s = str(v)
+    return f'"{s}"' if " " in s else s
+
+
+class TMLogger:
+    """Leveled KV logger bound to a module name + sticky fields
+    (tmlibs/log.Logger.With)."""
+
+    def __init__(self, name: str, fields: Optional[Dict[str, Any]] = None):
+        self._logger = logging.getLogger(f"{_ROOT}.{name}")
+        self.name = name
+        self.fields = dict(fields or {})
+
+    def with_fields(self, **kv) -> "TMLogger":
+        merged = dict(self.fields)
+        merged.update(kv)
+        return TMLogger(self.name, merged)
+
+    def _log(self, level: int, msg: str, kv: Dict[str, Any]) -> None:
+        if not self._logger.isEnabledFor(level):
+            return
+        merged = dict(self.fields)
+        merged.update(kv)
+        self._logger.log(level, msg, extra={"kv": merged})
+
+    def debug(self, msg: str, **kv) -> None:
+        self._log(logging.DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._log(logging.INFO, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._log(logging.ERROR, msg, kv)
+
+
+def get_logger(module: str, **fields) -> TMLogger:
+    _ensure_setup()
+    return TMLogger(module, fields or None)
+
+
+def _ensure_setup() -> None:
+    global _configured
+    if _configured:
+        return
+    with _setup_lock:
+        if _configured:
+            return
+        root = logging.getLogger(_ROOT)
+        if not root.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(KVFormatter())
+            root.addHandler(h)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _configured = True
+
+
+def setup_logging(spec: str = "info", stream=None) -> None:
+    """Configure levels from a reference-style spec (config/config.go:114):
+    either a bare level ("info") or "module:level,...,*:level"."""
+    global _configured
+    with _setup_lock:
+        root = logging.getLogger(_ROOT)
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(KVFormatter())
+        root.addHandler(h)
+        root.propagate = False
+        _configured = True
+
+    default = "info"
+    per_module = {}
+    for part in (spec or "info").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            mod, lvl = part.rsplit(":", 1)
+            if mod == "*":
+                default = lvl
+            else:
+                per_module[mod] = lvl
+        else:
+            default = part
+    root.setLevel(_LEVELS.get(default, logging.INFO))
+    # reset previously-set per-module levels, then apply the new spec
+    for name in list(logging.Logger.manager.loggerDict):
+        if name.startswith(_ROOT + "."):
+            logging.getLogger(name).setLevel(logging.NOTSET)
+    for mod, lvl in per_module.items():
+        logging.getLogger(f"{_ROOT}.{mod}").setLevel(
+            _LEVELS.get(lvl, logging.INFO))

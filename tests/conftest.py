@@ -1,15 +1,37 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
-Tests must not depend on real TPU hardware; multi-chip sharding paths are
-exercised on a virtual CPU mesh exactly as the driver's dryrun does.
-This must run before jax is imported anywhere.
+Tests must not depend on real TPU hardware; multi-chip sharding paths
+are exercised on a virtual CPU mesh exactly as the driver's dryrun does.
+
+On hosts where a TPU PJRT plugin is registered from sitecustomize (the
+axon tunnel pins JAX_PLATFORMS=axon before any of our code runs), env
+vars alone are too late — jax.config already captured them. The backend
+*client* however is not created until the first jax.devices() call, so
+steering jax.config here (before any test imports jax symbols that touch
+a backend) still lands us on an 8-device virtual CPU platform.
+
+Also enables a persistent XLA compilation cache so repeated test runs
+skip the expensive CPU recompiles of the Ed25519 ladder.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/tm_tpu_xla"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402  (after env setup, before any backend use)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# NOTE: no jax.devices() here — that would pay backend-client creation at
+# collection time for every run, including pure-Python test files.
+# tests/test_mesh.py asserts the 8-device CPU platform when it runs.

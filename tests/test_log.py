@@ -1,0 +1,75 @@
+"""Structured logging layer (utils/log.py) — tmlibs/log parity surface."""
+
+import io
+import logging
+
+from tendermint_tpu.utils import log as tmlog
+
+
+def capture():
+    buf = io.StringIO()
+    tmlog.setup_logging("debug", stream=buf)
+    return buf
+
+
+def test_kv_format_and_levels():
+    buf = capture()
+    lg = tmlog.get_logger("consensus")
+    lg.info("entering new round", height=5, round=0)
+    lg.error("bad vote", peer="abc")
+    lg.debug("gossip detail", part=3)
+    out = buf.getvalue()
+    lines = out.strip().split("\n")
+    assert lines[0].startswith("I[")
+    assert "entering new round" in lines[0]
+    assert "module=consensus" in lines[0]
+    assert "height=5" in lines[0] and "round=0" in lines[0]
+    assert lines[1].startswith("E[") and "peer=abc" in lines[1]
+    assert lines[2].startswith("D[") and "part=3" in lines[2]
+
+
+def test_with_fields_sticky():
+    buf = capture()
+    lg = tmlog.get_logger("p2p").with_fields(peer="deadbeef")
+    lg.info("msg one")
+    lg.info("msg two", ch=0x20)
+    out = buf.getvalue()
+    assert out.count("peer=deadbeef") == 2
+    assert "ch=32" in out
+
+
+def test_per_module_level_spec():
+    buf = io.StringIO()
+    # config/config.go:114-style spec: p2p silenced to error, default info
+    tmlog.setup_logging("p2p:error,*:info", stream=buf)
+    tmlog.get_logger("p2p").info("chatty p2p")
+    tmlog.get_logger("p2p").error("p2p failure")
+    tmlog.get_logger("state").info("state progress")
+    tmlog.get_logger("state").debug("state detail")
+    out = buf.getvalue()
+    assert "chatty p2p" not in out
+    assert "p2p failure" in out
+    assert "state progress" in out
+    assert "state detail" not in out
+    # restore default for other tests
+    tmlog.setup_logging("info")
+
+
+def test_bytes_rendered_as_hex_prefix():
+    buf = capture()
+    tmlog.get_logger("consensus").info("commit", hash=b"\xab\xcd" * 16)
+    assert "hash=abcdabcdabcdabcd" in buf.getvalue()
+
+
+def test_consensus_state_log_hooked():
+    """VERDICT round-1: ConsensusState._log was `pass`; errors must now
+    reach the log stream."""
+    from tendermint_tpu.consensus.state import ConsensusState
+    buf = capture()
+    cs = ConsensusState.__new__(ConsensusState)  # no full wiring needed
+    cs.logger = tmlog.get_logger("consensus")
+    from tendermint_tpu.consensus.rstate import RoundState
+    cs.rs = RoundState(height=7)
+    cs._log("something went wrong")
+    out = buf.getvalue()
+    assert "something went wrong" in out and "height=7" in out

@@ -322,3 +322,30 @@ def test_params_genesis_roundtrip(tmp_path):
     doc2 = GenesisDoc.load(path)
     assert doc2.bytes() == doc.bytes()
     assert doc2.validator_hash() == doc.validator_hash()
+
+
+# ------------------------------------------- ValidatorSet lookup scaling --
+
+def test_get_by_address_large_set():
+    """O(1) addr->index map vs the reference's binary search
+    (types/validator_set.go:93-101): 10k validators, lookups must not be
+    a linear scan (the round-1 implementation was O(V) per vote)."""
+    import time
+    n = 10_000
+    vals = [Validator(bytes([i & 0xFF, (i >> 8) & 0xFF]) + b"\x01" * 30, 1)
+            for i in range(n)]
+    vs = ValidatorSet(vals)
+    # correctness: every address found at the right index; misses miss
+    for i in (0, 1, n // 2, n - 1):
+        v = vs.validators[i]
+        idx, got = vs.get_by_address(v.address)
+        assert idx == i and got is v
+    assert vs.get_by_address(b"\xff" * 20) == (-1, None)
+    # scaling: 3 full-set lookup sweeps of 10k addrs each finish fast;
+    # a linear scan (~5k compares/lookup) would take tens of seconds
+    addrs = [v.address for v in vs.validators]
+    t0 = time.monotonic()
+    for _ in range(3):
+        for a in addrs:
+            vs.get_by_address(a)
+    assert time.monotonic() - t0 < 2.0
