@@ -1,0 +1,213 @@
+"""Fast-sync throughput bench (BASELINE.json config 4).
+
+Drives the real sync engine — BlockchainReactor._sync_window: per-window
+ONE batched device dispatch for every commit signature, then part-set
+build + store + ABCI apply per block — over a synthetic pre-built chain
+served by an infinitely-fast in-process peer. This is the workload of
+/root/reference/blockchain/reactor.go:216-302 (SYNC_LOOP: VerifyCommit
+per block at :286), where the reference spends one scalar Ed25519
+verify per validator per block.
+
+Standalone: `python bench_fastsync.py [n_blocks] [n_vals] [n_txs]`
+prints one JSON line. bench.py also imports `run()` and folds the
+result into its `extra` field for the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _fast_signer(seed: bytes):
+    """RFC 8032 signing via OpenSSL when available (ns per sig instead of
+    the pure-python oracle's ms), bit-identical output."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+        k = Ed25519PrivateKey.from_private_bytes(seed)
+        return k.sign
+    except ImportError:
+        from tendermint_tpu.utils import ed25519_ref as ref
+        return lambda msg: ref.sign(seed, msg)
+
+
+def build_chain(n_blocks: int, n_vals: int, n_txs: int):
+    """Pre-build a valid n_blocks chain: blocks[h-1] carries height h and
+    the LastCommit for h-1 signed by all validators."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.storage import MemDB, StateStore
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+    from tendermint_tpu.types.block import BlockID, Commit
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    keys = [PrivKey.generate((i + 1).to_bytes(32, "little"))
+            for i in range(n_vals)]
+    signers = {k.pubkey.address: _fast_signer((i + 1).to_bytes(32, "little"))
+               for i, k in enumerate(keys)}
+    gen = GenesisDoc(chain_id="bench-sync", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    state_store = StateStore(MemDB())
+    state = state_store.load_or_genesis(gen)
+    conns = AppConns(local_client_creator(KVStoreApp()))
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], gen.chain_id)
+    exec_ = BlockExecutor(state_store, conns.consensus)
+
+    part_size = state.consensus_params.block_gossip.block_part_size_bytes
+    blocks = []
+    last_commit = Commit()
+    for h in range(1, n_blocks + 1):
+        txs = [b"k%d.%d=v" % (h, i) for i in range(n_txs)]
+        block = state.make_block(h, txs, last_commit, time_ns=h * 10 ** 9)
+        parts = block.make_part_set(part_size)
+        block_id = BlockID(block.hash(), parts.header())
+        blocks.append(block)
+        # all validators precommit the block (the commit that block h+1
+        # will carry as LastCommit)
+        precommits = []
+        for idx, val in enumerate(state.validators.validators):
+            v = Vote(validator_address=val.address, validator_index=idx,
+                     height=h, round=0, timestamp_ns=h * 10 ** 9 + 1,
+                     type=VoteType.PRECOMMIT, block_id=block_id)
+            v.signature = signers[val.address](v.sign_bytes(gen.chain_id))
+            precommits.append(v)
+        last_commit = Commit(block_id, precommits)
+        state = exec_.apply_block(state.copy(), block_id, block,
+                                  trust_last_commit=True)
+    # one sentinel block at n_blocks+1 so the sync window can verify
+    # block n_blocks with its child's LastCommit
+    sentinel = state.make_block(n_blocks + 1, [], last_commit,
+                                time_ns=(n_blocks + 1) * 10 ** 9)
+    blocks.append(sentinel)
+    return gen, blocks
+
+
+def sync_chain(gen, blocks, verify_window: int = 64,
+               backend: str = "auto", verifier=None) -> dict:
+    """Fresh node syncs the whole chain through the reactor's window
+    engine fed by an in-process instant peer. `verifier` overrides the
+    backend string (used for the scalar baseline run)."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    from tendermint_tpu.blockchain import BlockchainReactor
+    from tendermint_tpu.models.verifier import BatchVerifier
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.storage import BlockStore, MemDB, StateStore
+
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_store.load_or_genesis(gen)
+    conns = AppConns(local_client_creator(KVStoreApp()))
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], gen.chain_id)
+    exec_ = BlockExecutor(state_store, conns.consensus,
+                          verifier=verifier or BatchVerifier(backend))
+    reactor = BlockchainReactor(state, exec_, block_store, fast_sync=True,
+                                verify_window=verify_window)
+
+    # instant peer: a request for height h is answered synchronously
+    def send_request(peer_id: str, height: int) -> bool:
+        blk = blocks[height - 1]
+        reactor.pool.add_block(peer_id, blk, 1)
+        return True
+
+    reactor.pool.send_request = send_request
+    n_sync = len(blocks) - 1
+    reactor.pool.set_peer_height("bench-peer", len(blocks))
+    t0 = time.perf_counter()
+    reactor.pool.make_next_requests()
+    while reactor.state.last_block_height < n_sync:
+        if not reactor._sync_window():
+            reactor.pool.make_next_requests()
+    dt = time.perf_counter() - t0
+    n_vals = len(gen.validators)
+    return {
+        "blocks": n_sync, "seconds": round(dt, 3),
+        "blocks_per_sec": round(n_sync / dt, 1),
+        "verifies_per_sec": round(n_sync * n_vals / dt, 1),
+        "backend": backend if verifier is None else type(verifier).__name__,
+        "verifier_stats": dict(exec_.verifier.stats),
+    }
+
+
+def run(n_blocks: int = 512, n_vals: int = 64, n_txs: int = 32,
+        scalar_baseline: bool = True) -> dict:
+    """Build once, sync twice (device batch path vs scalar-CPU verify
+    fallback) and report the ratio."""
+    t0 = time.perf_counter()
+    gen, blocks = build_chain(n_blocks, n_vals, n_txs)
+    build_s = time.perf_counter() - t0
+
+    out = sync_chain(gen, blocks, backend="auto")
+    out["build_seconds"] = round(build_s, 1)
+    out["n_vals"] = n_vals
+    out["n_txs"] = n_txs
+    if scalar_baseline:
+        out_scalar = sync_chain(gen, blocks, verifier=_ScalarVerifier())
+        out["scalar_blocks_per_sec"] = out_scalar["blocks_per_sec"]
+        out["vs_scalar"] = round(
+            out["blocks_per_sec"] / out_scalar["blocks_per_sec"], 2)
+    return out
+
+
+class _ScalarVerifier:
+    """One-at-a-time OpenSSL verifies — the reference's execution model
+    (types/validator_set.go:257: one PubKey.VerifyBytes per precommit)
+    on the fastest scalar backend available (a conservative baseline:
+    OpenSSL is faster than Go's ed25519)."""
+
+    def __init__(self):
+        self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}
+
+    def verify(self, items):
+        import numpy as np
+        self.stats["calls"] += 1
+        self.stats["sigs"] += len(items)
+        try:
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PublicKey,
+            )
+        except ImportError:
+            from tendermint_tpu.utils import ed25519_ref as ref
+            return np.array([ref.verify(p, m, s) for p, m, s in items],
+                            np.bool_)
+        out = np.zeros(len(items), np.bool_)
+        for i, (p, m, s) in enumerate(items):
+            try:
+                Ed25519PublicKey.from_public_bytes(p).verify(s, m)
+                out[i] = True
+            except Exception:
+                pass
+        return out
+
+    def verify_one(self, pubkey, msg, sig):
+        return bool(self.verify([(pubkey, msg, sig)])[0])
+
+
+def main() -> int:
+    n_blocks = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    n_txs = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+    res = run(n_blocks, n_vals, n_txs)
+    print(json.dumps({
+        "metric": "fastsync_blocks_per_sec",
+        "value": res["blocks_per_sec"],
+        "unit": "blocks/sec",
+        "vs_baseline": res.get("vs_scalar", 0.0),
+        "extra": res,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

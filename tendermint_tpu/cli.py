@@ -52,6 +52,9 @@ def cmd_node(args) -> int:
     if args.rpc_laddr:
         node.config.rpc.laddr = args.rpc_laddr
         node.with_rpc = True
+    if args.grpc_laddr:
+        # gRPC only — does not turn on the HTTP JSON-RPC listener
+        node.config.rpc.grpc_laddr = args.grpc_laddr
     if args.persistent_peers:
         node.config.p2p.persistent_peers = args.persistent_peers
     node.start()
@@ -176,6 +179,20 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """cmd/tendermint/commands/probe_upnp.go: discover an IGD and report
+    its capabilities as JSON."""
+    import json as _json
+    from tendermint_tpu.p2p import upnp
+    try:
+        report = upnp.probe(timeout=args.timeout)
+    except upnp.UPnPError as e:
+        print(_json.dumps({"ok": False, "error": str(e)}))
+        return 1
+    print(_json.dumps({"ok": True, "capabilities": report}))
+    return 0
+
+
 def cmd_show_node_id(args) -> int:
     from tendermint_tpu.p2p import NodeKey
     nk = NodeKey.load_or_generate(
@@ -286,6 +303,8 @@ def main(argv=None) -> int:
                     help="override p2p listen address")
     sp.add_argument("--rpc-laddr", default="",
                     help="serve RPC on this address")
+    sp.add_argument("--grpc-laddr", default="",
+                    help="serve the gRPC BroadcastAPI on this address")
     sp.add_argument("--persistent-peers", default="",
                     help="comma-separated id@host:port")
     sp.set_defaults(fn=cmd_node)
@@ -314,6 +333,11 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_lite)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("probe_upnp",
+                        help="probe the local network for a UPnP IGD")
+    sp.add_argument("--timeout", type=float, default=3.0)
+    sp.set_defaults(fn=cmd_probe_upnp)
     sub.add_parser("show_validator").set_defaults(fn=cmd_show_validator)
     sub.add_parser("show_node_id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("gen_validator").set_defaults(fn=cmd_gen_validator)

@@ -43,6 +43,8 @@ class P2PConfig:
     pex: bool = True
     seed_mode: bool = False
     addr_book_strict: bool = True
+    skip_upnp: bool = True   # opt-in UPnP (reference default differs;
+    #                          zero-egress/test environments must not probe)
     handshake_timeout_s: float = 20.0
     dial_timeout_s: float = 3.0
 

@@ -124,6 +124,15 @@ class ConsensusState:
     def _on_timeout_fire(self, ti: TimeoutInfo) -> None:
         self.submit({"type": "timeout", "ti": ti.to_obj()})
 
+    def _enqueue_own(self, msg: dict) -> None:
+        """Append one of our OWN messages (proposal/part/vote) from inside
+        the drain loop — the still-running drain persists it to the WAL
+        and handles it in order. Asserting _processing keeps the
+        single-writer discipline honest: a caller outside the loop would
+        silently skip WAL persistence and must use submit() instead."""
+        assert self._processing, "outside the drain loop: use submit()"
+        self._queue.append((msg, ""))
+
     # -------------------------------------------------------------- messaging
 
     def _handle(self, msg: dict, peer_id: str) -> None:
@@ -354,12 +363,12 @@ class ConsensusState:
                 self._log(f"error signing proposal: {e!r}")
             return
         # own proposal + parts ride the same queue as peer messages
-        self._queue.append(({"type": "proposal",
-                             "proposal": proposal.to_obj()}, ""))
+        self._enqueue_own({"type": "proposal",
+                           "proposal": proposal.to_obj()})
         for i in range(parts.total):
             part = parts.get_part(i)
-            self._queue.append(({"type": "block_part", "height": height,
-                                 "round": round_, "part": part.to_obj()}, ""))
+            self._enqueue_own({"type": "block_part", "height": height,
+                               "round": round_, "part": part.to_obj()})
         self._broadcast({"type": "proposal", "proposal": proposal.to_obj()})
         for i in range(parts.total):
             self._broadcast({"type": "block_part", "height": height,
@@ -763,5 +772,5 @@ class ConsensusState:
             if not self.replay_mode:
                 self._log(f"error signing vote: {e!r}")
             return
-        self._queue.append(({"type": "vote", "vote": vote.to_obj()}, ""))
+        self._enqueue_own({"type": "vote", "vote": vote.to_obj()})
         self._broadcast({"type": "vote", "vote": vote.to_obj()})

@@ -64,6 +64,8 @@ def _load():
         lib.tm_merkle_proof.argtypes = [u8p, u64p, ctypes.c_uint64,
                                         ctypes.c_uint64, u8p, u8p]
         lib.tm_merkle_proof.restype = ctypes.c_uint64
+        lib.tm_ed25519_prepare.argtypes = [u8p, u8p, u8p, u64p,
+                                           ctypes.c_uint64, u8p, u8p]
         _lib = lib
         return _lib
 
@@ -73,14 +75,14 @@ def available() -> bool:
 
 
 def _pack(items: List[bytes]):
-    import ctypes
+    import numpy as np
     data = b"".join(items)
-    offsets = (ctypes.c_uint64 * (len(items) + 1))()
-    pos = 0
-    for i, it in enumerate(items):
-        offsets[i] = pos
-        pos += len(it)
-    offsets[len(items)] = pos
+    n = len(items)
+    off = np.zeros(n + 1, np.uint64)
+    if n:
+        np.cumsum(np.fromiter((len(it) for it in items), np.uint64, n),
+                  out=off[1:])
+    offsets = (ctypes.c_uint64 * (n + 1)).from_buffer_copy(off.tobytes())
     buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
         data or b"\x00")
     return buf, offsets
@@ -117,6 +119,34 @@ def merkle_root_from_digests(digests: List[bytes]) -> Optional[bytes]:
     out = (ctypes.c_uint8 * 32)()
     lib.tm_merkle_root_from_digests(buf, len(digests), out)
     return bytes(out)
+
+
+def ed25519_prepare(pk_bytes: bytes, sig_bytes: bytes,
+                    msgs: List[bytes]):
+    """Batched Ed25519 host prep: h = SHA512(R||A||M) mod L plus the
+    s < L precheck, one C call for the whole batch. pk_bytes/sig_bytes
+    are the n*32 / n*64 contiguous arrays. Returns (h_bytes, pre) as
+    numpy arrays, or None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+    n = len(msgs)
+    if len(pk_bytes) != 32 * n or len(sig_bytes) != 64 * n:
+        raise ValueError(
+            f"ed25519_prepare: {n} msgs need {32 * n}/{64 * n} pk/sig "
+            f"bytes, got {len(pk_bytes)}/{len(sig_bytes)}")
+    buf, offsets = _pack(msgs)
+    pk = (ctypes.c_uint8 * max(1, len(pk_bytes))).from_buffer_copy(
+        pk_bytes or b"\x00")
+    sg = (ctypes.c_uint8 * max(1, len(sig_bytes))).from_buffer_copy(
+        sig_bytes or b"\x00")
+    h_out = (ctypes.c_uint8 * (32 * n))()
+    pre_out = (ctypes.c_uint8 * max(1, n))()
+    lib.tm_ed25519_prepare(pk, sg, buf, offsets, n, h_out, pre_out)
+    h = np.frombuffer(bytes(h_out), np.uint8).reshape(n, 32).copy()
+    pre = np.frombuffer(bytes(pre_out), np.uint8)[:n].astype(bool).copy()
+    return h, pre
 
 
 def merkle_proof(items: List[bytes], index: int):

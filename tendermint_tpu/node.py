@@ -112,6 +112,7 @@ class Node:
 
         self.rpc_server = None
         self.rpc_address = None
+        self.grpc_server = None
         self.with_rpc = with_rpc
 
         # tx indexer + service (node/node.go:294-320)
@@ -209,11 +210,22 @@ class Node:
 
         self.indexer_service.start()
 
-        if self.with_rpc:
+        # HTTP and gRPC listeners are independent: asking for one must
+        # not bind the other (a gRPC-only operator should not get the
+        # full JSON-RPC surface on the config-default 0.0.0.0 address)
+        if self.with_rpc or self.config.rpc.grpc_laddr:
             from tendermint_tpu.rpc import RPCEnv, make_server
-            self.rpc_server, _ = make_server(RPCEnv.from_node(self))
-            host, port = _parse_laddr(self.config.rpc.laddr)
-            self.rpc_address = self.rpc_server.serve(host, port)
+            self.rpc_server, core = make_server(RPCEnv.from_node(self))
+            if self.with_rpc:
+                host, port = _parse_laddr(self.config.rpc.laddr)
+                self.rpc_address = self.rpc_server.serve(host, port)
+            if self.config.rpc.grpc_laddr:
+                from tendermint_tpu.rpc.grpc_service import BroadcastAPIServer
+                self.grpc_server = BroadcastAPIServer(
+                    core, self.config.rpc.grpc_laddr)
+                self.grpc_server.start()
+                self.logger.info("grpc broadcast api listening",
+                                 port=self.grpc_server.port)
 
     def _dial_configured_peers(self) -> None:
         from tendermint_tpu.p2p import NetAddress
@@ -229,6 +241,8 @@ class Node:
                 [NetAddress.from_string(a) for a in seeds])
 
     def stop(self) -> None:
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.indexer_service.stop()

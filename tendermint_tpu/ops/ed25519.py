@@ -56,8 +56,31 @@ def prepare_batch_bytes(pubkeys, msgs, sigs):
 
     precheck is False for malformed inputs (bad lengths, s >= L); such
     entries still flow through the kernel with zeroed scalars so the
-    batch shape stays static."""
+    batch shape stays static.
+
+    When every pubkey/sig has the canonical length, the whole batch is
+    prepared by ONE call into the native hostops (SHA-512 + mod-L in
+    C++, native/hostops.cpp tm_ed25519_prepare) — the per-signature
+    Python loop below is the fallback and the malformed-input path."""
     n = len(pubkeys)
+    pk_list = [bytes(p) for p in pubkeys]
+    sg_list = [bytes(s) for s in sigs]
+    if n > 0 and all(len(p) == 32 for p in pk_list) and \
+            all(len(s) == 64 for s in sg_list):
+        from tendermint_tpu import native
+        pk_cat = b"".join(pk_list)
+        sg_cat = b"".join(sg_list)
+        out = native.ed25519_prepare(pk_cat, sg_cat,
+                                     [bytes(m) for m in msgs])
+        if out is not None:
+            h_bytes, pre = out
+            sg = np.frombuffer(sg_cat, np.uint8).reshape(n, 64)
+            pk = np.frombuffer(pk_cat, np.uint8).reshape(n, 32).copy()
+            rb = sg[:, :32].copy()
+            s_bytes = np.where(pre[:, None], sg[:, 32:], 0).astype(np.uint8)
+            pk[~pre] = 0
+            rb[~pre] = 0
+            return pk, rb, s_bytes, h_bytes, pre
     pk = np.zeros((n, 32), np.uint8)
     rb = np.zeros((n, 32), np.uint8)
     s_bytes = np.zeros((n, 32), np.uint8)

@@ -116,11 +116,56 @@ def _mul_t(a, b):
 
 
 def _mul_small_t(a, k: int):
-    return _normalize_t(a * k, passes=3)
+    """a*k for tiny static k. For k<=2 one carry pass restores the limb
+    bound: 2a < 2^14.4 so carries <= 2, and the last-limb fold adds
+    <= 2*FOLD to limb 0 — total < 2^13.3. Larger k keeps 3 passes."""
+    return _normalize_t(a * k, passes=1 if k <= 2 else 3)
 
 
 def _square_t(a):
+    """Squaring = schoolbook mul. A symmetric-half variant (row i against
+    pre-doubled a[i+1:], 210 MACs vs 400) was tried and is SLOWER on
+    Mosaic: the ragged [20-i, B] segments still occupy full 8-sublane
+    tiles, so the tile count only drops ~25% while the extra concats and
+    non-uniform shapes cost more than that. Keep the uniform shape."""
     return _mul_t(a, a)
+
+
+def _sqn_t(x, n: int):
+    """n successive squarings (fori_loop keeps the Mosaic program small)."""
+    return jax.lax.fori_loop(0, n, lambda i, acc: _square_t(acc), x)
+
+
+def _chain_250_t(z):
+    """Shared prefix of the classic curve25519 exponentiation chain:
+    returns (z^(2^250-1), z^11). 249 squarings + 9 multiplications —
+    replaces bit-by-bit square-and-multiply (~250 sq + ~125-250 mul)."""
+    z2 = _square_t(z)
+    z8 = _sqn_t(z2, 2)
+    z9 = _mul_t(z, z8)
+    z11 = _mul_t(z2, z9)
+    z22 = _square_t(z11)
+    z_5_0 = _mul_t(z9, z22)                    # z^(2^5-1)
+    z_10_0 = _mul_t(_sqn_t(z_5_0, 5), z_5_0)   # z^(2^10-1)
+    z_20_0 = _mul_t(_sqn_t(z_10_0, 10), z_10_0)
+    z_40_0 = _mul_t(_sqn_t(z_20_0, 20), z_20_0)
+    z_50_0 = _mul_t(_sqn_t(z_40_0, 10), z_10_0)
+    z_100_0 = _mul_t(_sqn_t(z_50_0, 50), z_50_0)
+    z_200_0 = _mul_t(_sqn_t(z_100_0, 100), z_100_0)
+    z_250_0 = _mul_t(_sqn_t(z_200_0, 50), z_50_0)
+    return z_250_0, z11
+
+
+def _inv_t(z):
+    """z^(p-2) = z^(2^255-21): chain prefix + 5 squarings + 1 mul."""
+    z_250_0, z11 = _chain_250_t(z)
+    return _mul_t(_sqn_t(z_250_0, 5), z11)
+
+
+def _pow_p58_t(z):
+    """z^((p-5)/8) = z^(2^252-3): chain prefix + 2 squarings + 1 mul."""
+    z_250_0, _ = _chain_250_t(z)
+    return _mul_t(_sqn_t(z_250_0, 2), z)
 
 
 # ---------------------------------------------------------------------------
@@ -236,36 +281,21 @@ def _to_bytes_t(x):
     return jnp.stack(out, axis=0)
 
 
-def _pow_bits_t(x, bits_ref, nbits):
-    """x**e for a static exponent whose MSB-first bits live in bits_ref
-    (int32[nbits]). fori_loop square-and-multiply."""
-    one = _one_t(x.shape[1])
-
-    def body(i, acc):
-        acc = _square_t(acc)
-        bit = bits_ref[i]  # scalar SMEM load
-        acc_mul = _mul_t(acc, x)
-        return jnp.where(bit == 1, acc_mul, acc)
-
-    return jax.lax.fori_loop(0, nbits, body, one)
-
-
 # ---------------------------------------------------------------------------
 # The fused verify kernel: decompress + ladder + encode + compare, all VMEM
 # ---------------------------------------------------------------------------
 
 def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
-                   d_ref, d2_ref, sqrt_m1_ref,
-                   p58_bits_ref, pm2_bits_ref, out_ref, an_scratch):
+                   d_ref, d2_ref, sqrt_m1_ref, out_ref, an_scratch):
     """out[B] = 1 iff the signature verifies.
 
     pk, rb:      int32[32, B] pubkey / signature-R bytes.
     dig_s/dig_h: int32[64, B] 4-bit scalar windows.
     s_table:     int32[16, 4, 20] k*B constants.
     consts:      int32[4, 20]: D, D2, SQRT_M1, ONE(unused spare).
-    p58_bits:    int32[n58] MSB-first bits of (p-5)/8.
-    pm2_bits:    int32[n2]  MSB-first bits of p-2.
-    """
+    Fixed exponentiations (sqrt-ratio's ^((p-5)/8), encode's ^(p-2)) use
+    the classic curve25519 addition chain (_chain_250_t) instead of
+    bit-vector square-and-multiply."""
     bsz = pk_ref.shape[-1]
 
     def cvec(ref):
@@ -282,9 +312,7 @@ def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
     # sqrt_ratio
     v3 = _mul_t(_square_t(v), v)
     v7 = _mul_t(_square_t(v3), v)
-    n58 = p58_bits_ref.shape[0]
-    r = _mul_t(_mul_t(u, v3),
-               _pow_bits_t(_mul_t(u, v7), p58_bits_ref, n58))
+    r = _mul_t(_mul_t(u, v3), _pow_p58_t(_mul_t(u, v7)))
     check = _mul_t(v, _square_t(r))
     u_bytes = _to_bytes_t(u)
     neg_u_bytes = _to_bytes_t(_sub_t(_zero_t(bsz), u))
@@ -334,8 +362,7 @@ def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
     X, Y, Z, _ = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz))
 
     # ---- encode result + compare with R (curve.encode, transposed)
-    n2 = pm2_bits_ref.shape[0]
-    zi = _pow_bits_t(Z, pm2_bits_ref, n2)
+    zi = _inv_t(Z)
     xa = _mul_t(X, zi)
     ya = _mul_t(Y, zi)
     by = _to_bytes_t(ya)
@@ -354,12 +381,6 @@ def _consts_np():
     out[2] = fe.SQRT_M1
     out[3] = fe.ONE
     return out
-
-
-@functools.lru_cache(maxsize=None)
-def _exp_bits_np(exp: int):
-    return np.array([(exp >> i) & 1
-                     for i in reversed(range(exp.bit_length()))], np.int32)
 
 
 def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
@@ -394,18 +415,13 @@ def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
-                # exponent bit vectors: scalar dynamic reads -> SMEM
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
             scratch_shapes=[pltpu.VMEM((4, NLIMBS, tile), jnp.int32)],
         ),
         interpret=interpret,
     )(pk_t, rb_t, dig_s, dig_h, jnp.asarray(_s_table_np()),
-      jnp.asarray(fe.D), jnp.asarray(fe.D2), jnp.asarray(fe.SQRT_M1),
-      jnp.asarray(_exp_bits_np((fe.P - 5) // 8)),
-      jnp.asarray(_exp_bits_np(fe.P - 2)))
+      jnp.asarray(fe.D), jnp.asarray(fe.D2), jnp.asarray(fe.SQRT_M1))
     return out[0].astype(jnp.bool_)
 
 

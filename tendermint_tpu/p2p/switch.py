@@ -121,6 +121,14 @@ class Switch:
         bound = ls.getsockname()
         adv_host = external_host or getattr(
             self.config, "external_addr", "") or bound[0]
+        if adv_host in ("0.0.0.0", "::") and \
+                not getattr(self.config, "skip_upnp", True):
+            # UPnP external-address detection (p2p/listener.go:51);
+            # best-effort, sub-2s budget, opt-in via config
+            from tendermint_tpu.p2p import upnp
+            ext = upnp.external_address()
+            if ext:
+                adv_host = ext
         if adv_host in ("0.0.0.0", "::"):
             # best effort: a wildcard bind with no configured external
             # address advertises the hostname's primary IP

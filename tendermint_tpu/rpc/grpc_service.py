@@ -1,0 +1,108 @@
+"""gRPC BroadcastAPI — server + client.
+
+Capability parity with the reference's minimal gRPC surface
+(/root/reference/rpc/grpc/types.proto:33-36, client_server.go:15,34):
+`Ping` and `BroadcastTx`, where BroadcastTx submits through the full
+commit path (the reference implements it via core.BroadcastTxCommit) and
+returns both the CheckTx and DeliverTx results.
+
+grpc_tools is not in the image, so the service is wired with
+`grpc.method_handlers_generic_handler` over the protoc-generated
+messages instead of generated *_pb2_grpc stubs.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from tendermint_tpu.rpc.proto import tmtpu_pb2 as pb
+
+_SERVICE = "tendermint_tpu.BroadcastAPI"
+
+
+def _tx_result(obj: Optional[dict]) -> pb.TxResult:
+    if not obj:
+        return pb.TxResult()
+    return pb.TxResult(
+        code=obj.get("code", 0), data=bytes.fromhex(obj.get("data") or ""),
+        log=obj.get("log", ""),
+        tags={str(k): str(v) for k, v in (obj.get("tags") or {}).items()},
+        gas_wanted=obj.get("gas_wanted", 0))
+
+
+class BroadcastAPIServer:
+    """Serves Ping + BroadcastTx over the RPCCore handlers."""
+
+    def __init__(self, core, laddr: str, max_workers: int = 8):
+        """core: rpc.core.RPCCore; laddr: 'host:port' or
+        'tcp://host:port' (port 0 picks a free port)."""
+        self.core = core
+        addr = laddr.replace("tcp://", "")
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.port = self._server.add_insecure_port(addr)
+
+    def _handler(self):
+        def ping(request, context):
+            return pb.PingResponse()
+
+        def broadcast_tx(request, context):
+            from tendermint_tpu.rpc.server import RPCError
+            try:
+                res = self.core.broadcast_tx_commit(request.tx)
+            except RPCError as e:
+                context.abort(grpc.StatusCode.INTERNAL, e.message)
+                return
+            return pb.BroadcastTxResponse(
+                check_tx=_tx_result(res.get("check_tx")),
+                deliver_tx=_tx_result(res.get("deliver_tx")),
+                hash=bytes.fromhex(res.get("hash") or ""),
+                height=res.get("height", 0))
+
+        handlers = {
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                ping, request_deserializer=pb.PingRequest.FromString,
+                response_serializer=pb.PingResponse.SerializeToString),
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                broadcast_tx,
+                request_deserializer=pb.BroadcastTxRequest.FromString,
+                response_serializer=pb.BroadcastTxResponse.SerializeToString),
+        }
+        return grpc.method_handlers_generic_handler(_SERVICE, handlers)
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class BroadcastAPIClient:
+    """Client for BroadcastAPIServer (rpc/grpc/client_server.go:15)."""
+
+    def __init__(self, address: str, timeout: float = 60.0):
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            address.replace("tcp://", ""))
+        self._ping = self._channel.unary_unary(
+            f"/{_SERVICE}/Ping",
+            request_serializer=pb.PingRequest.SerializeToString,
+            response_deserializer=pb.PingResponse.FromString)
+        self._broadcast = self._channel.unary_unary(
+            f"/{_SERVICE}/BroadcastTx",
+            request_serializer=pb.BroadcastTxRequest.SerializeToString,
+            response_deserializer=pb.BroadcastTxResponse.FromString)
+
+    def ping(self) -> None:
+        self._ping(pb.PingRequest(), timeout=self.timeout)
+
+    def broadcast_tx(self, tx: bytes) -> pb.BroadcastTxResponse:
+        return self._broadcast(pb.BroadcastTxRequest(tx=tx),
+                               timeout=self.timeout)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -39,6 +39,11 @@ _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 # under 1 MB.
 MAX_BODY_BYTES = 1 << 20
 
+# Cap on concurrent WebSocket connections: each one holds a handler
+# thread plus an event-pump thread, so an unauthenticated client must
+# not be able to grow them without bound.
+MAX_WS_CONNS = 100
+
 
 class RPCError(Exception):
     def __init__(self, code: int, message: str, data=None):
@@ -320,6 +325,11 @@ class RPCServer:
                     self._reply(_rpc_response(-1, error=e))
 
             def _upgrade_websocket(self):
+                if len(server._ws_conns) >= MAX_WS_CONNS:
+                    self._reply(_rpc_response(None, error=RPCError(
+                        -32000, "too many websocket connections")), 503)
+                    self.close_connection = True
+                    return
                 key = self.headers.get("Sec-WebSocket-Key", "")
                 accept = base64.b64encode(hashlib.sha1(
                     (key + _WS_MAGIC).encode()).digest()).decode()

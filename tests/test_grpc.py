@@ -1,0 +1,105 @@
+"""gRPC surface: BroadcastAPI (rpc/grpc/types.proto parity) and the
+ABCI-over-gRPC transport (proxy/client.go:65 grpc ClientCreator)."""
+
+import hashlib
+import time
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.grpc_app import (ABCIGrpcServer, GrpcClient,
+                                          grpc_client_creator)
+from tendermint_tpu.abci.proxy import AppConns
+from tendermint_tpu.abci.types import ValidatorUpdate
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.rpc.grpc_service import (BroadcastAPIClient,
+                                             BroadcastAPIServer)
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+
+# ---------------------------------------------------------- ABCI over gRPC
+
+def test_abci_grpc_roundtrip():
+    app = KVStoreApp()
+    server = ABCIGrpcServer(app, "127.0.0.1:0")
+    server.start()
+    try:
+        c = GrpcClient(f"127.0.0.1:{server.port}")
+        assert c.echo("hello") == "hello"
+        info = c.info()
+        assert info.last_block_height == 0
+
+        c.init_chain([ValidatorUpdate(b"\x01" * 32, 10)], "grpc-chain")
+        c.begin_block(b"\xaa" * 32, {"height": 1, "time_ns": 1},
+                      absent_validators=[], byzantine_validators=[])
+        res = c.deliver_tx(b"k=v")
+        assert res.code == 0 and res.tags
+        batch = c.deliver_tx_batch([b"a=1", b"b=2"])
+        assert [r.code for r in batch] == [0, 0]
+        eb = c.end_block(1)
+        assert eb.validator_updates == []
+        app_hash = c.commit()
+        assert app_hash
+
+        q = c.query("/key", b"k")
+        assert q.value == b"v"
+        chk = c.check_tx(b"x=y")
+        assert chk.ok
+        bad = c.check_tx(b"")   # kvstore rejects the empty tx
+        assert not bad.ok and bad.code == 1
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_abci_grpc_client_creator_with_appconns():
+    """The node-side usage: three AppConns over three channels."""
+    app = KVStoreApp()
+    server = ABCIGrpcServer(app, "127.0.0.1:0")
+    server.start()
+    try:
+        conns = AppConns(grpc_client_creator(f"127.0.0.1:{server.port}"))
+        assert conns.query.info().last_block_height == 0
+        assert conns.mempool.check_tx(b"k=v").ok
+        conns.consensus.begin_block(b"\x01" * 32, {"height": 1})
+        conns.consensus.deliver_tx(b"k=v")
+        conns.consensus.end_block(1)
+        assert conns.consensus.commit()
+        conns.close()
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------- BroadcastAPI
+
+@pytest.fixture(scope="module")
+def grpc_node():
+    key = PrivKey.generate(b"\x0b" * 32)
+    gen = GenesisDoc(chain_id="grpc-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    cfg = make_test_config("")
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+    node = Node(cfg, gen, priv_validator=PrivValidator(LocalSigner(key)),
+                in_memory=True, with_rpc=True)
+    node.start()
+    deadline = time.monotonic() + 30
+    while node.height < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert node.height >= 2
+    yield node
+    node.stop()
+
+
+def test_broadcast_api_ping_and_tx(grpc_node):
+    c = BroadcastAPIClient(f"127.0.0.1:{grpc_node.grpc_server.port}")
+    c.ping()  # must not raise
+    tx = b"gk=gv"
+    res = c.broadcast_tx(tx)
+    assert res.check_tx.code == 0
+    assert res.deliver_tx.code == 0
+    assert res.height >= 1
+    assert res.hash == hashlib.sha256(tx).digest()
+    c.close()

@@ -23,8 +23,11 @@ rng = random.Random(41)
 
 # Fail loudly (not skip) if conftest's platform steering broke: the whole
 # multi-chip story depends on these tests actually running on 8 devices.
-assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, \
-    f"test mesh misconfigured: {jax.devices()}"
+# A fixture (not module-level) so deselected runs don't pay backend init.
+@pytest.fixture(autouse=True, scope="module")
+def _require_virtual_mesh():
+    assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, \
+        f"test mesh misconfigured: {jax.devices()}"
 
 
 def signed_batch(n, tamper=()):

@@ -340,3 +340,36 @@ def test_node_key_persistence(tmp_path):
     nk = NodeKey.load_or_generate(path)
     nk2 = NodeKey.load_or_generate(path)
     assert nk.id() == nk2.id()
+
+
+# ------------------------------------------------------------- flow rate --
+
+def test_flow_monitor_windowed_eviction_signal():
+    """A previously-fast peer that stalls must drop below the eviction
+    floor within one window (tmlibs/flowrate semantics used at
+    blockchain/pool.go:35-42) — the lifetime average would not."""
+    import time as _time
+    from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+    m = FlowMonitor(window_s=0.5)
+    for _ in range(20):
+        m.update(10_000)
+    fast = m.rate
+    assert fast > 7_680  # well above MIN_RECV_RATE while transferring
+    _time.sleep(0.8)     # stall for > window
+    assert m.rate < 1_000, m.rate      # windowed signal collapsed
+    assert m.lifetime_rate > 7_680     # lifetime stat still high
+    assert m.total == 200_000
+
+
+def test_bp_peer_slow_after_stall(monkeypatch):
+    import time as _time
+    from tendermint_tpu.blockchain import pool as bpool
+    monkeypatch.setattr(bpool, "MIN_RATE_GRACE_S", 0.2)
+    p = bpool.BpPeer("p1", height=100)
+    p.on_request()
+    p.recv_monitor.window_s = 0.4
+    p.recv_monitor.update(500_000)   # fast burst
+    p.on_request()                   # still-pending requests
+    assert not p.is_slow()           # fast while transferring
+    _time.sleep(0.7)                 # stall past grace + window
+    assert p.is_slow()

@@ -1,0 +1,139 @@
+"""UPnP against a loopback fake IGD (p2p/upnp parity without a network):
+a UDP SSDP responder + an HTTP server answering the device-description
+and SOAP control requests the way a router's IGD stack does."""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from tendermint_tpu.p2p import upnp
+
+DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device>
+   <serviceList><service>
+    <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+    <controlURL>/ctl</controlURL>
+   </service></serviceList>
+  </device></deviceList>
+ </device>
+</root>"""
+
+
+class FakeIGD:
+    """Loopback SSDP + HTTP IGD. Records port mappings."""
+
+    def __init__(self):
+        self.mappings = {}
+        # HTTP part (description + SOAP control)
+        igd = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, body: bytes, code=200):
+                self.send_response(code)
+                self.send_header("Content-Type", "text/xml")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(DESC_XML.encode())
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+                action = self.headers.get("SOAPAction", "").split("#")[-1]
+                action = action.strip('"')
+                if action == "GetExternalIPAddress":
+                    self._reply(_soap_resp(action, {
+                        "NewExternalIPAddress": "203.0.113.7"}))
+                elif action == "AddPortMapping":
+                    port = _extract(body, "NewExternalPort")
+                    igd.mappings[port] = _extract(body, "NewInternalClient")
+                    self._reply(_soap_resp(action, {}))
+                elif action == "DeletePortMapping":
+                    igd.mappings.pop(_extract(body, "NewExternalPort"), None)
+                    self._reply(_soap_resp(action, {}))
+                else:
+                    self._reply(b"unknown action", 500)
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        # SSDP part: plain loopback UDP (no multicast in the sandbox)
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.udp.getsockname()
+        threading.Thread(target=self._ssdp_loop, daemon=True).start()
+
+    def _ssdp_loop(self):
+        while True:
+            try:
+                data, addr = self.udp.recvfrom(2048)
+            except OSError:
+                return
+            if b"M-SEARCH" in data:
+                resp = ("HTTP/1.1 200 OK\r\n"
+                        f"LOCATION: http://127.0.0.1:{self.http_port}/desc.xml\r\n"
+                        f"ST: {upnp.ST_IGD}\r\n\r\n").encode()
+                self.udp.sendto(resp, addr)
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.udp.close()
+
+
+def _soap_resp(action: str, fields: dict) -> bytes:
+    inner = "".join(f"<{k}>{v}</{k}>" for k, v in fields.items())
+    return (f'<?xml version="1.0"?><s:Envelope xmlns:s='
+            f'"http://schemas.xmlsoap.org/soap/envelope/"><s:Body>'
+            f'<u:{action}Response xmlns:u="svc">{inner}'
+            f"</u:{action}Response></s:Body></s:Envelope>").encode()
+
+
+def _extract(body: str, tag: str) -> str:
+    return body.split(f"<{tag}>")[1].split(f"</{tag}>")[0]
+
+
+def test_discover_and_port_mapping_roundtrip():
+    igd_srv = FakeIGD()
+    try:
+        igd = upnp.discover(timeout=2.0, ssdp_addr=igd_srv.ssdp_addr)
+        assert igd.service_type.endswith("WANIPConnection:1")
+        assert igd.external_ip() == "203.0.113.7"
+        igd.add_port_mapping(46656, 46656)
+        assert "46656" in igd_srv.mappings
+        igd.delete_port_mapping(46656)
+        assert "46656" not in igd_srv.mappings
+    finally:
+        igd_srv.close()
+
+
+def test_probe_reports_capabilities():
+    igd_srv = FakeIGD()
+    try:
+        report = upnp.probe(timeout=2.0, ssdp_addr=igd_srv.ssdp_addr)
+        assert report["external_ip"] == "203.0.113.7"
+        assert report["port_mapping"] is True
+        assert not igd_srv.mappings  # probe cleans up its test mapping
+    finally:
+        igd_srv.close()
+
+
+def test_no_igd_raises():
+    import pytest
+    # a bound-but-silent UDP port: discovery must time out cleanly
+    silent = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    silent.bind(("127.0.0.1", 0))
+    try:
+        with pytest.raises(upnp.UPnPError):
+            upnp.discover(timeout=0.3, ssdp_addr=silent.getsockname())
+    finally:
+        silent.close()
