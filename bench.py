@@ -29,23 +29,11 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 def scalar_baseline_rate(pubs, msgs, sigs, budget_s=3.0) -> float:
     """Scalar verifies/sec, one at a time, OpenSSL backend (fallback: our
     pure-python ref, scaled measurement)."""
-    try:
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PublicKey,
-        )
+    from bench_util import scalar_verify_one
+    _v = scalar_verify_one()
 
-        def verify_one(i):
-            try:
-                Ed25519PublicKey.from_public_bytes(pubs[i]).verify(
-                    sigs[i], msgs[i])
-                return True
-            except Exception:
-                return False
-    except ImportError:
-        from tendermint_tpu.utils import ed25519_ref as ref
-
-        def verify_one(i):
-            return ref.verify(pubs[i], msgs[i], sigs[i])
+    def verify_one(i):
+        return _v(pubs[i], msgs[i], sigs[i])
 
     n_done = 0
     t0 = time.perf_counter()

@@ -20,18 +20,8 @@ import sys
 import time
 
 
-def _fast_signer(seed: bytes):
-    """RFC 8032 signing via OpenSSL when available (ns per sig instead of
-    the pure-python oracle's ms), bit-identical output."""
-    try:
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PrivateKey,
-        )
-        k = Ed25519PrivateKey.from_private_bytes(seed)
-        return k.sign
-    except ImportError:
-        from tendermint_tpu.utils import ed25519_ref as ref
-        return lambda msg: ref.sign(seed, msg)
+from bench_util import ScalarVerifier as _ScalarVerifier
+from bench_util import fast_signer as _fast_signer
 
 
 def build_chain(n_blocks: int, n_vals: int, n_txs: int):
@@ -158,40 +148,6 @@ def run(n_blocks: int = 512, n_vals: int = 64, n_txs: int = 32,
         out["vs_scalar"] = round(
             out["blocks_per_sec"] / out_scalar["blocks_per_sec"], 2)
     return out
-
-
-class _ScalarVerifier:
-    """One-at-a-time OpenSSL verifies — the reference's execution model
-    (types/validator_set.go:257: one PubKey.VerifyBytes per precommit)
-    on the fastest scalar backend available (a conservative baseline:
-    OpenSSL is faster than Go's ed25519)."""
-
-    def __init__(self):
-        self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}
-
-    def verify(self, items):
-        import numpy as np
-        self.stats["calls"] += 1
-        self.stats["sigs"] += len(items)
-        try:
-            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-                Ed25519PublicKey,
-            )
-        except ImportError:
-            from tendermint_tpu.utils import ed25519_ref as ref
-            return np.array([ref.verify(p, m, s) for p, m, s in items],
-                            np.bool_)
-        out = np.zeros(len(items), np.bool_)
-        for i, (p, m, s) in enumerate(items):
-            try:
-                Ed25519PublicKey.from_public_bytes(p).verify(s, m)
-                out[i] = True
-            except Exception:
-                pass
-        return out
-
-    def verify_one(self, pubkey, msg, sig):
-        return bool(self.verify([(pubkey, msg, sig)])[0])
 
 
 def main() -> int:

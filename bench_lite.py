@@ -1,0 +1,135 @@
+"""Lite-client chain certification bench (BASELINE.json config 5).
+
+The reference's light client certifies headers one at a time — one
+`ValidatorSet.VerifyCommit` (V scalar Ed25519 verifies) per header
+(lite/static_certifier.go:57; lite/performance_test.go:10-105 measures
+exactly this loop). Here a whole run of consecutive headers goes through
+`lite.certify_chain`, which pools EVERY commit signature across the
+chain into batched device dispatches.
+
+Workload: N synthetic headers, each signed by V validators — N·V
+signatures certified end-to-end (structural checks + quorum math on
+host, signatures on device). Reported as headers/sec with the
+scalar-OpenSSL baseline measured over the same per-header verify loop.
+
+Standalone: `python bench_lite.py [n_headers] [n_vals]` prints one JSON
+line. bench.py folds `run()` into its `extra` field for the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+from bench_util import fast_signer
+
+
+def _signers(keys):
+    return {k.pubkey.address: fast_signer(k.seed) for k in keys}
+
+
+def build_chain(n_headers: int, n_vals: int, chain_id: str = "bench-lite"):
+    """[FullCommit] for heights 1..n_headers, one constant valset."""
+    from tendermint_tpu.lite.types import FullCommit, SignedHeader
+    from tendermint_tpu.types import PrivKey
+    from tendermint_tpu.types.block import (BlockID, Commit, Header,
+                                            PartSetHeader)
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    keys = [PrivKey.generate((i + 1).to_bytes(32, "little"))
+            for i in range(n_vals)]
+    valset = ValidatorSet([Validator(k.pubkey.ed25519, 10) for k in keys])
+    sign = _signers(keys)
+    by_addr = {v.address: i for i, v in enumerate(valset.validators)}
+
+    fcs = []
+    for height in range(1, n_headers + 1):
+        header = Header(chain_id=chain_id, height=height, time_ns=height,
+                        validators_hash=valset.hash(),
+                        app_hash=height.to_bytes(32, "big"))
+        bid = BlockID(header.hash(), PartSetHeader(1, b"\x22" * 32))
+        precommits = [None] * n_vals
+        for k in keys:
+            idx = by_addr[k.pubkey.address]
+            v = Vote(k.pubkey.address, idx, height, 0, height,
+                     VoteType.PRECOMMIT, bid)
+            v.signature = sign[k.pubkey.address](v.sign_bytes(chain_id))
+            precommits[idx] = v
+        fcs.append(FullCommit(
+            SignedHeader(header, Commit(bid, precommits), bid), valset))
+    return fcs, valset
+
+
+def scalar_baseline_rate(fcs, chain_id: str, budget_s: float = 3.0):
+    """Headers/sec for the reference execution model: one scalar Ed25519
+    verify per precommit per header (lite/performance_test.go's loop),
+    on the FASTEST scalar backend available (OpenSSL beats Go's
+    x/crypto, so this is a conservative baseline)."""
+    from bench_util import scalar_verify_one
+    _v = scalar_verify_one()
+
+    def verify(pub, sig, msg):
+        assert _v(pub, msg, sig)
+
+    n_done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        fc = fcs[n_done % len(fcs)]
+        pubs = {v.address: v.pubkey for v in fc.validators.validators}
+        for pc in fc.signed_header.commit.precommits:
+            if pc is not None:
+                verify(pubs[pc.validator_address], pc.signature,
+                       pc.sign_bytes(chain_id))
+        n_done += 1
+    return n_done / (time.perf_counter() - t0)
+
+
+def run(n_headers: int = 2000, n_vals: int = 64,
+        with_baseline: bool = True) -> dict:
+    from tendermint_tpu.lite.certifier import certify_chain
+
+    chain_id = "bench-lite"
+    t0 = time.perf_counter()
+    fcs, valset = build_chain(n_headers, n_vals)
+    build_s = time.perf_counter() - t0
+
+    # warmup (compiles the batch kernel shapes)
+    certify_chain(chain_id, fcs[:64], trusted=valset)
+
+    t0 = time.perf_counter()
+    certify_chain(chain_id, fcs, trusted=valset)
+    dt = time.perf_counter() - t0
+    rate = n_headers / dt
+
+    out = {
+        "headers_per_sec": round(rate, 1),
+        "headers": n_headers, "vals_per_header": n_vals,
+        "sig_verifies_per_sec": round(rate * n_vals, 1),
+        "certify_s": round(dt, 3), "build_s": round(build_s, 1),
+    }
+    if with_baseline:
+        base = scalar_baseline_rate(fcs, chain_id)
+        out["scalar_headers_per_sec"] = round(base, 1)
+        out["vs_baseline"] = round(rate / base, 2)
+    return out
+
+
+def main() -> int:
+    n_headers = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    r = run(n_headers, n_vals)
+    print(json.dumps({
+        "metric": "lite_chain_certify",
+        "value": r["headers_per_sec"],
+        "unit": "headers/sec",
+        "vs_baseline": r.get("vs_baseline", 0.0),
+        "extra": r,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

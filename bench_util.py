@@ -1,0 +1,66 @@
+"""Shared scalar-crypto shims for the bench suite (bench.py,
+bench_fastsync.py, bench_lite.py).
+
+Baselines model the reference's execution: one scalar Ed25519 op per
+signature on a single core (types/validator_set.go:257). OpenSSL (via
+`cryptography`) is used when available — it is FASTER than Go's
+x/crypto ed25519, so every vs_baseline number is conservative; the
+pure-python RFC 8032 oracle is the fallback.
+"""
+
+from __future__ import annotations
+
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    HAVE_OPENSSL = True
+except ImportError:  # pragma: no cover - image always has cryptography
+    Ed25519PrivateKey = Ed25519PublicKey = None
+    HAVE_OPENSSL = False
+
+
+def fast_signer(seed: bytes):
+    """sign(msg) -> 64-byte signature for the given 32-byte seed;
+    OpenSSL when available (ns/sig), bit-identical pure-python oracle
+    otherwise."""
+    if HAVE_OPENSSL:
+        return Ed25519PrivateKey.from_private_bytes(seed).sign
+    from tendermint_tpu.utils import ed25519_ref as ref
+    return lambda msg: ref.sign(seed, msg)
+
+
+def scalar_verify_one():
+    """verify(pub, msg, sig) -> bool, one at a time, fastest scalar
+    backend available."""
+    if HAVE_OPENSSL:
+        def verify(pub, msg, sig):
+            try:
+                Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+                return True
+            except Exception:
+                return False
+        return verify
+    from tendermint_tpu.utils import ed25519_ref as ref
+    return lambda pub, msg, sig: ref.verify(pub, msg, sig)
+
+
+class ScalarVerifier:
+    """BatchVerifier-shaped adapter that verifies one-at-a-time on the
+    scalar backend — the reference's execution model, used as the
+    baseline arm of the fast-sync and lite benches."""
+
+    def __init__(self):
+        self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}
+        self._verify = scalar_verify_one()
+
+    def verify(self, items):
+        import numpy as np
+        self.stats["calls"] += 1
+        self.stats["sigs"] += len(items)
+        return np.array([self._verify(p, m, s) for p, m, s in items],
+                        np.bool_)
+
+    def verify_one(self, pub, msg, sig) -> bool:
+        return self._verify(pub, msg, sig)

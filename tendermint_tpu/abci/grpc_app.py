@@ -16,14 +16,15 @@ deterministic encoding — inside protoc-generated messages
 
 from __future__ import annotations
 
-from concurrent import futures
-from typing import List, Optional
+from typing import List
 
 import grpc
 
 from tendermint_tpu.abci.types import (ResultCheckTx, ResultDeliverTx,
                                        ResultEndBlock, ResultInfo,
                                        ResultQuery, ValidatorUpdate)
+from tendermint_tpu.rpc.grpc_util import (GrpcServerBase, make_stubs,
+                                          strip_tcp)
 from tendermint_tpu.rpc.proto import tmtpu_pb2 as pb
 from tendermint_tpu.types import encoding
 
@@ -65,21 +66,19 @@ def _json_or_none(b: bytes):
     return encoding.cloads(b) if b else None
 
 
-class ABCIGrpcServer:
+class ABCIGrpcServer(GrpcServerBase):
     """Serves one BaseApplication over gRPC; calls are serialized onto
     the app with the server's own lock, matching the socket server's
     single-app discipline."""
+
+    SERVICE = _SERVICE
 
     def __init__(self, app, laddr: str = "127.0.0.1:0",
                  max_workers: int = 8):
         import threading
         self.app = app
         self._lock = threading.Lock()
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
-        self._server.add_generic_rpc_handlers((self._handler(),))
-        self.port = self._server.add_insecure_port(
-            laddr.replace("tcp://", ""))
+        super().__init__(laddr, max_workers=max_workers)
 
     # one method per rpc; each takes the decoded request, returns response
     def _do_echo(self, req):
@@ -137,26 +136,16 @@ class ABCIGrpcServer:
     def _do_commit(self, req):
         return pb.CommitResponse(data=self.app.commit())
 
-    def _handler(self):
+    def handlers(self):
         def wrap(fn):
             def call(request, context):
                 with self._lock:
                     return fn(request)
             return call
 
-        handlers = {}
-        for m in _METHODS:
-            fn = getattr(self, f"_do_{m.lower()}")
-            handlers[m] = grpc.unary_unary_rpc_method_handler(
-                wrap(fn), request_deserializer=_REQ[m].FromString,
-                response_serializer=_RESP[m].SerializeToString)
-        return grpc.method_handlers_generic_handler(_SERVICE, handlers)
-
-    def start(self) -> None:
-        self._server.start()
-
-    def stop(self, grace: float = 0.5) -> None:
-        self._server.stop(grace)
+        return {m: (wrap(getattr(self, f"_do_{m.lower()}")),
+                    _REQ[m], _RESP[m])
+                for m in _METHODS}
 
 
 class GrpcClient:
@@ -164,13 +153,8 @@ class GrpcClient:
 
     def __init__(self, address: str, timeout: float = 10.0):
         self.timeout = timeout
-        self._channel = grpc.insecure_channel(address.replace("tcp://", ""))
-        self._stubs = {
-            m: self._channel.unary_unary(
-                f"/{_SERVICE}/{m}",
-                request_serializer=_REQ[m].SerializeToString,
-                response_deserializer=_RESP[m].FromString)
-            for m in _METHODS}
+        self._channel = grpc.insecure_channel(strip_tcp(address))
+        self._stubs = make_stubs(self._channel, _SERVICE, _REQ, _RESP)
 
     def _call(self, method: str, request):
         return self._stubs[method](request, timeout=self.timeout)

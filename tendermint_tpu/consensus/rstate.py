@@ -35,9 +35,12 @@ class POLInfo:
 
 class HeightVoteSet:
     """round → {prevotes, precommits} for one height
-    (consensus/types/height_vote_set.go:32-129). Peer catch-up votes may
-    create vote sets up to 2 rounds beyond the current round — enough to
-    learn about skips without unbounded memory."""
+    (consensus/types/height_vote_set.go:32-129). A peer's votes may
+    lazily create vote sets for rounds we haven't reached — but each
+    peer may open at most MAX_CATCHUP_ROUNDS such rounds (the
+    reference's peerCatchupRounds bound :107-129), which keeps memory
+    bounded by the peer count while still letting a node that joined
+    late accept a commit that happened many rounds ahead of it."""
 
     MAX_CATCHUP_ROUNDS = 2
 
@@ -49,6 +52,7 @@ class HeightVoteSet:
         self.verifier = verifier
         self.round = 0
         self._sets: Dict[tuple, VoteSet] = {}
+        self._peer_catchup: Dict[str, list] = {}
         self.set_round(0)
 
     def _make(self, round_: int) -> None:
@@ -59,8 +63,11 @@ class HeightVoteSet:
                     verifier=self.verifier)
 
     def set_round(self, round_: int) -> None:
-        self._make(round_)
-        self._make(round_ + 1)  # catchup room, as the reference pre-makes
+        # pre-make EVERY round up to round_+1, like the reference's
+        # SetRound/addRound: after a round-skip the gap rounds must
+        # exist, or gossip for them would burn peers' catchup allowance
+        for r in range(self.round, round_ + 2):
+            self._make(r)
         self.round = max(self.round, round_)
 
     def prevotes(self, round_: int) -> Optional[VoteSet]:
@@ -72,9 +79,14 @@ class HeightVoteSet:
     def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
         vs = self._sets.get((vote.round, vote.type))
         if vs is None:
-            if vote.round > self.round + self.MAX_CATCHUP_ROUNDS and peer_id:
-                raise ValueError(
-                    f"vote round {vote.round} too far beyond {self.round}")
+            if peer_id:
+                rounds = self._peer_catchup.setdefault(peer_id, [])
+                if vote.round not in rounds:
+                    if len(rounds) >= self.MAX_CATCHUP_ROUNDS:
+                        raise ValueError(
+                            f"vote round {vote.round}: peer {peer_id!r} "
+                            f"exhausted its catchup-round allowance")
+                    rounds.append(vote.round)
             self._make(vote.round)
             vs = self._sets[(vote.round, vote.type)]
         return vs.add_vote(vote)

@@ -17,6 +17,7 @@ adds wrap natively), bytes are uint8.
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 
@@ -43,23 +44,43 @@ def _rotr(x, n):
 
 
 def compress(state, block):
-    """One SHA-256 compression: state uint32[...,8], block uint32[...,16]."""
-    w = [block[..., t] for t in range(16)]
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
-    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
-    for t in range(64):
+    """One SHA-256 compression: state uint32[...,8], block uint32[...,16].
+
+    The 48 schedule steps and 64 rounds run under lax.fori_loop, NOT
+    unrolled: a Merkle program hashes at every tree level, and fully
+    unrolled rounds made the 8-way-SPMD tree compile pathological on
+    XLA:CPU (>10 min, tens of GB of compiler RSS — an O(ops²) pass).
+    Looped rounds keep every hash ~60x smaller in the HLO. The round
+    body is elementwise over the batch, so on TPU the loop overhead
+    amortizes across lanes; each level is still one wide VPU batch."""
+    w = jnp.concatenate(
+        [block, jnp.zeros(block.shape[:-1] + (48,), jnp.uint32)], axis=-1)
+
+    def sched(t, w):
+        take = lambda off: jax.lax.dynamic_index_in_dim(
+            w, t - off, axis=-1, keepdims=False)
+        w15, w2, w16, w7 = take(15), take(2), take(16), take(7)
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        return jax.lax.dynamic_update_index_in_dim(
+            w, w16 + s0 + w7 + s1, t, axis=-1)
+
+    w = jax.lax.fori_loop(16, 64, sched, w)
+    k_const = jnp.asarray(_K)
+
+    def round_(t, carry):
+        a, b, c, d, e, f, g, h = carry
         S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + S1 + ch + jnp.uint32(_K[t]) + w[t]
+        wt = jax.lax.dynamic_index_in_dim(w, t, axis=-1, keepdims=False)
+        t1 = h + S1 + ch + k_const[t] + wt
         S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = S0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
-    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
-    return state + out
+        return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(
+        0, 64, round_, tuple(state[..., i] for i in range(8)))
+    return state + jnp.stack(out, axis=-1)
 
 
 _BYTE_SHIFTS = np.array([24, 16, 8, 0], dtype=np.uint32)

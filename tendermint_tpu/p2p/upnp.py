@@ -116,6 +116,18 @@ def _parse_ssdp_location(datagram: bytes) -> Optional[str]:
     return None
 
 
+def _local_ip_toward(location: str) -> str:
+    """The local interface IP that routes toward the gateway."""
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect((urlparse(location).hostname or "8.8.8.8", 9))
+        return probe.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        probe.close()
+
+
 def discover(timeout: float = 3.0,
              ssdp_addr=SSDP_ADDR, local_ip: Optional[str] = None) -> IGD:
     """SSDP M-SEARCH for an IGD, then resolve its WAN control URL
@@ -126,36 +138,46 @@ def discover(timeout: float = 3.0,
            "MX: 2\r\n"
            f"ST: {ST_IGD}\r\n\r\n").encode()
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    sock.settimeout(timeout)
     try:
         try:
             sock.sendto(msg, ssdp_addr)
         except OSError as e:  # no route to multicast (airgapped hosts)
             raise UPnPError(f"SSDP send failed: {e}") from e
+        # `timeout` is the TOTAL discover budget: every recvfrom is
+        # clamped to the remaining deadline (unrelated SSDP chatter must
+        # not extend the window) and the device-description fetch below
+        # runs on whatever budget is left.
         deadline = time.monotonic() + timeout
-        location = None
-        while time.monotonic() < deadline:
+        seen: set = set()
+        last_err: Optional[Exception] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            sock.settimeout(remaining)
             try:
                 data, _ = sock.recvfrom(4096)
             except socket.timeout:
                 break
             location = _parse_ssdp_location(data)
-            if location:
+            if not location or location in seen:
+                continue
+            seen.add(location)
+            if local_ip is None:
+                local_ip = _local_ip_toward(location)
+            # a non-IGD device may answer first (media servers commonly
+            # reply regardless of ST): probe it, and on failure keep
+            # reading until the deadline instead of giving up
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 break
-        if not location:
-            raise UPnPError("no IGD responded to SSDP search")
-        if local_ip is None:
-            # the interface that routes toward the gateway
-            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             try:
-                probe.connect((urlparse(location).hostname or "8.8.8.8",
-                               9))
-                local_ip = probe.getsockname()[0]
-            except OSError:
-                local_ip = "127.0.0.1"
-            finally:
-                probe.close()
-        return _device_from_location(location, local_ip, timeout)
+                return _device_from_location(location, local_ip, remaining)
+            except UPnPError as e:
+                last_err = e
+        if last_err is not None:
+            raise UPnPError(f"no usable IGD found: {last_err}")
+        raise UPnPError("no IGD responded to SSDP search")
     finally:
         sock.close()
 
@@ -213,8 +235,17 @@ def probe(timeout: float = 3.0, ssdp_addr=SSDP_ADDR,
 
 def external_address(timeout: float = 1.5) -> Optional[str]:
     """Best-effort external IP for listener advertisement
-    (p2p/listener.go:51 GetUPNPExternalAddress): None when no IGD."""
+    (p2p/listener.go:51 GetUPNPExternalAddress): None when no IGD.
+
+    `timeout` bounds the WHOLE operation: the GetExternalIPAddress SOAP
+    call only gets what discover left of the budget, so listener startup
+    stalls at most ~timeout, not a per-call multiple of it."""
+    t0 = time.monotonic()
     try:
-        return discover(timeout=timeout).external_ip(timeout=timeout)
+        igd = discover(timeout=timeout)
+        remaining = timeout - (time.monotonic() - t0)
+        if remaining <= 0:
+            return None
+        return igd.external_ip(timeout=remaining)
     except (UPnPError, OSError):
         return None

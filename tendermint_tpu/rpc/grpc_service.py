@@ -8,19 +8,23 @@ returns both the CheckTx and DeliverTx results.
 
 grpc_tools is not in the image, so the service is wired with
 `grpc.method_handlers_generic_handler` over the protoc-generated
-messages instead of generated *_pb2_grpc stubs.
+messages instead of generated *_pb2_grpc stubs; the shared scaffolding
+(bind policy, stub maps) lives in rpc/grpc_util.py.
 """
 
 from __future__ import annotations
 
-from concurrent import futures
 from typing import Optional
 
 import grpc
 
+from tendermint_tpu.rpc.grpc_util import GrpcServerBase, make_stubs, strip_tcp
 from tendermint_tpu.rpc.proto import tmtpu_pb2 as pb
 
 _SERVICE = "tendermint_tpu.BroadcastAPI"
+
+_REQ = {"Ping": pb.PingRequest, "BroadcastTx": pb.BroadcastTxRequest}
+_RESP = {"Ping": pb.PingResponse, "BroadcastTx": pb.BroadcastTxResponse}
 
 
 def _tx_result(obj: Optional[dict]) -> pb.TxResult:
@@ -33,20 +37,18 @@ def _tx_result(obj: Optional[dict]) -> pb.TxResult:
         gas_wanted=obj.get("gas_wanted", 0))
 
 
-class BroadcastAPIServer:
+class BroadcastAPIServer(GrpcServerBase):
     """Serves Ping + BroadcastTx over the RPCCore handlers."""
+
+    SERVICE = _SERVICE
 
     def __init__(self, core, laddr: str, max_workers: int = 8):
         """core: rpc.core.RPCCore; laddr: 'host:port' or
         'tcp://host:port' (port 0 picks a free port)."""
         self.core = core
-        addr = laddr.replace("tcp://", "")
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
-        self._server.add_generic_rpc_handlers((self._handler(),))
-        self.port = self._server.add_insecure_port(addr)
+        super().__init__(laddr, max_workers=max_workers)
 
-    def _handler(self):
+    def handlers(self):
         def ping(request, context):
             return pb.PingResponse()
 
@@ -63,22 +65,9 @@ class BroadcastAPIServer:
                 hash=bytes.fromhex(res.get("hash") or ""),
                 height=res.get("height", 0))
 
-        handlers = {
-            "Ping": grpc.unary_unary_rpc_method_handler(
-                ping, request_deserializer=pb.PingRequest.FromString,
-                response_serializer=pb.PingResponse.SerializeToString),
-            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
-                broadcast_tx,
-                request_deserializer=pb.BroadcastTxRequest.FromString,
-                response_serializer=pb.BroadcastTxResponse.SerializeToString),
-        }
-        return grpc.method_handlers_generic_handler(_SERVICE, handlers)
-
-    def start(self) -> None:
-        self._server.start()
-
-    def stop(self, grace: float = 0.5) -> None:
-        self._server.stop(grace)
+        return {"Ping": (ping, _REQ["Ping"], _RESP["Ping"]),
+                "BroadcastTx": (broadcast_tx, _REQ["BroadcastTx"],
+                                _RESP["BroadcastTx"])}
 
 
 class BroadcastAPIClient:
@@ -86,23 +75,15 @@ class BroadcastAPIClient:
 
     def __init__(self, address: str, timeout: float = 60.0):
         self.timeout = timeout
-        self._channel = grpc.insecure_channel(
-            address.replace("tcp://", ""))
-        self._ping = self._channel.unary_unary(
-            f"/{_SERVICE}/Ping",
-            request_serializer=pb.PingRequest.SerializeToString,
-            response_deserializer=pb.PingResponse.FromString)
-        self._broadcast = self._channel.unary_unary(
-            f"/{_SERVICE}/BroadcastTx",
-            request_serializer=pb.BroadcastTxRequest.SerializeToString,
-            response_deserializer=pb.BroadcastTxResponse.FromString)
+        self._channel = grpc.insecure_channel(strip_tcp(address))
+        self._stubs = make_stubs(self._channel, _SERVICE, _REQ, _RESP)
 
     def ping(self) -> None:
-        self._ping(pb.PingRequest(), timeout=self.timeout)
+        self._stubs["Ping"](pb.PingRequest(), timeout=self.timeout)
 
     def broadcast_tx(self, tx: bytes) -> pb.BroadcastTxResponse:
-        return self._broadcast(pb.BroadcastTxRequest(tx=tx),
-                               timeout=self.timeout)
+        return self._stubs["BroadcastTx"](pb.BroadcastTxRequest(tx=tx),
+                                          timeout=self.timeout)
 
     def close(self) -> None:
         self._channel.close()

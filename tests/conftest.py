@@ -10,8 +10,12 @@ vars alone are too late — jax.config already captured them. The backend
 steering jax.config here (before any test imports jax symbols that touch
 a backend) still lands us on an 8-device virtual CPU platform.
 
-Also enables a persistent XLA compilation cache so repeated test runs
-skip the expensive CPU recompiles of the Ed25519 ladder.
+The persistent XLA compilation cache is deliberately OFF here: making a
+CPU executable serializable forces XLA:CPU through its AOT pipeline,
+which for the 8-way SPMD merkle program (shard_map + all_gather) takes
+>400s vs 32s for the plain JIT compile — the cache turns a one-minute
+suite warmup into a hang. Within one pytest process each kernel shape
+compiles once anyway.
 """
 
 import os
@@ -21,16 +25,11 @@ _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
           if "xla_force_host_platform_device_count" not in f]
 _flags.append("--xla_force_host_platform_device_count=8")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.expanduser("~/.cache/tm_tpu_xla"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import jax  # noqa: E402  (after env setup, before any backend use)
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 # NOTE: no jax.devices() here — that would pay backend-client creation at
 # collection time for every run, including pure-Python test files.

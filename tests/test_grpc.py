@@ -103,3 +103,22 @@ def test_broadcast_api_ping_and_tx(grpc_node):
     assert res.height >= 1
     assert res.hash == hashlib.sha256(tx).digest()
     c.close()
+
+
+def test_grpc_bind_conflict_raises():
+    """grpcio enables SO_REUSEPORT by default, under which two nodes
+    binding the same grpc_laddr BOTH succeed and the kernel round-robins
+    RPCs between them. We disable it (rpc/grpc_util.py): the second bind
+    must fail loudly, like the reference's net.Listen
+    (rpc/grpc/client_server.go:15)."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.grpc_app import ABCIGrpcServer
+
+    first = ABCIGrpcServer(KVStoreApp(), "127.0.0.1:0")
+    try:
+        # grpcio raises RuntimeError at add_insecure_port on conflict;
+        # OSError is our own guard for the silent-0 case
+        with pytest.raises((OSError, RuntimeError)):
+            ABCIGrpcServer(KVStoreApp(), f"127.0.0.1:{first.port}")
+    finally:
+        first.stop()

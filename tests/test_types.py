@@ -349,3 +349,59 @@ def test_get_by_address_large_set():
         for a in addrs:
             vs.get_by_address(a)
     assert time.monotonic() - t0 < 2.0
+
+
+# --------------------------------------------- HeightVoteSet catchup --
+
+def test_height_vote_set_peer_catchup_rounds():
+    """A peer may open vote sets for rounds far beyond ours — up to
+    MAX_CATCHUP_ROUNDS distinct rounds per peer (the reference's
+    peerCatchupRounds bound, consensus/types/height_vote_set.go:107-129).
+    This is how a late joiner accepts a commit that happened at round 6
+    while it still sits at round 0."""
+    from tendermint_tpu.consensus.rstate import HeightVoteSet
+
+    vs, privs = make_valset(4)
+    hvs = HeightVoteSet(CHAIN, 1, vs, verifier=PYV)
+    bid = make_block_id()
+
+    # rounds 0 and 1 are pre-made; round 6 is a peer catchup round
+    v6 = signed_vote(privs[0], 0, 1, 6, VoteType.PRECOMMIT, bid)
+    assert hvs.add_vote(v6, peer_id="peerA")
+    assert hvs.precommits(6) is not None
+    # same peer, second catchup round: still allowed
+    v9 = signed_vote(privs[1], 1, 1, 9, VoteType.PRECOMMIT, bid)
+    assert hvs.add_vote(v9, peer_id="peerA")
+    # third distinct round from the same peer: allowance exhausted
+    v12 = signed_vote(privs[2], 2, 1, 12, VoteType.PRECOMMIT, bid)
+    with pytest.raises(ValueError):
+        hvs.add_vote(v12, peer_id="peerA")
+    # ...but more votes into an ALREADY-OPEN round don't burn allowance
+    v6b = signed_vote(privs[3], 3, 1, 6, VoteType.PRECOMMIT, bid)
+    assert hvs.add_vote(v6b, peer_id="peerA")
+    # another peer has its own allowance
+    assert hvs.add_vote(v12, peer_id="peerB")
+    # internal votes (no peer) are never limited
+    v20 = signed_vote(privs[0], 0, 1, 20, VoteType.PREVOTE, bid)
+    assert hvs.add_vote(v20)
+
+
+def test_height_vote_set_gap_rounds_do_not_burn_allowance():
+    """After a round-skip, votes for the skipped-over rounds are normal
+    gossip — they must NOT charge the peer's catchup allowance (the
+    reference pre-makes every round up to the current one)."""
+    from tendermint_tpu.consensus.rstate import HeightVoteSet
+
+    vs, privs = make_valset(4)
+    hvs = HeightVoteSet(CHAIN, 1, vs, verifier=PYV)
+    bid = make_block_id()
+    hvs.set_round(7)  # skip 0 -> 7: rounds 0..8 all pre-made
+    # gap-round votes from one peer: free
+    for r in (1, 3, 5):
+        v = signed_vote(privs[0], 0, 1, r, VoteType.PRECOMMIT, bid)
+        assert hvs.add_vote(v, peer_id="peerA")
+    # the same peer still has its full 2-round catchup allowance
+    v12 = signed_vote(privs[1], 1, 1, 12, VoteType.PRECOMMIT, bid)
+    v15 = signed_vote(privs[2], 2, 1, 15, VoteType.PRECOMMIT, bid)
+    assert hvs.add_vote(v12, peer_id="peerA")
+    assert hvs.add_vote(v15, peer_id="peerA")
