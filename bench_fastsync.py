@@ -16,8 +16,19 @@ result into its `extra` field for the driver.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+# persistent XLA compilation cache — TPU backends only (TPU executables
+# serialize cheaply; on CPU the cache forces the pathological AOT
+# pipeline, see tests/conftest.py). The env decides before jax inits.
+if os.environ.get("PALLAS_AXON_POOL_IPS") or any(
+        p in os.environ.get("JAX_PLATFORMS", "") for p in ("tpu", "axon")):
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
 
 
 from bench_util import ScalarVerifier as _ScalarVerifier
@@ -138,6 +149,10 @@ def run(n_blocks: int = 512, n_vals: int = 64, n_txs: int = 32,
     gen, blocks = build_chain(n_blocks, n_vals, n_txs)
     build_s = time.perf_counter() - t0
 
+    # untimed warmup sync: compiles every kernel shape the measured
+    # run will hit (each new batch shape costs a full TPU compile, which
+    # would otherwise land inside the timed loop)
+    sync_chain(gen, blocks, backend="auto")
     out = sync_chain(gen, blocks, backend="auto")
     out["build_seconds"] = round(build_s, 1)
     out["n_vals"] = n_vals

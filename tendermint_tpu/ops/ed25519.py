@@ -216,15 +216,13 @@ def _bucket(n: int, min_size: int = 8) -> int:
     return b
 
 
-def verify_batch(pubkeys, msgs, sigs, kernel=None) -> np.ndarray:
-    """Verify N (pubkey, msg, sig) triples; returns bool[N].
-
-    Batches are padded to power-of-two sizes so repeated calls hit the jit
-    cache. `kernel` may be a sharded variant (parallel/mesh.py).
-    """
+def verify_batch_async(pubkeys, msgs, sigs, kernel=None):
+    """Dispatch one padded batch WITHOUT blocking: returns
+    (device_result, precheck bool[N]). jax dispatch is asynchronous, so
+    a caller with several chunks can enqueue them all and let device
+    compute overlap host prep + transfers — on tunneled TPU links the
+    per-call round-trip otherwise dominates end-to-end throughput."""
     n = len(pubkeys)
-    if n == 0:
-        return np.zeros(0, np.bool_)
     pk, rb, s_bytes, h_bytes, pre = prepare_batch_bytes(pubkeys, msgs, sigs)
     m = _bucket(n)
     args = (jnp.asarray(_pad_to(pk, m)), jnp.asarray(_pad_to(rb, m)),
@@ -236,4 +234,17 @@ def verify_batch(pubkeys, msgs, sigs, kernel=None) -> np.ndarray:
                      bits_from_bytes_dev(args[3]))
     else:
         res = verify_from_bytes_best(*args)
+    return res, pre
+
+
+def verify_batch(pubkeys, msgs, sigs, kernel=None) -> np.ndarray:
+    """Verify N (pubkey, msg, sig) triples; returns bool[N].
+
+    Batches are padded to power-of-two sizes so repeated calls hit the jit
+    cache. `kernel` may be a sharded variant (parallel/mesh.py).
+    """
+    n = len(pubkeys)
+    if n == 0:
+        return np.zeros(0, np.bool_)
+    res, pre = verify_batch_async(pubkeys, msgs, sigs, kernel=kernel)
     return np.asarray(res)[:n] & pre

@@ -163,8 +163,12 @@ def discover(timeout: float = 3.0,
             if not location or location in seen:
                 continue
             seen.add(location)
-            if local_ip is None:
-                local_ip = _local_ip_toward(location)
+            # per-candidate local IP: on a multi-homed host a failing
+            # first responder may sit on a different interface than the
+            # real IGD, and the port mapping must advertise the address
+            # that routes toward the device actually used
+            ip = local_ip if local_ip is not None \
+                else _local_ip_toward(location)
             # a non-IGD device may answer first (media servers commonly
             # reply regardless of ST): probe it, and on failure keep
             # reading until the deadline instead of giving up
@@ -172,7 +176,7 @@ def discover(timeout: float = 3.0,
             if remaining <= 0:
                 break
             try:
-                return _device_from_location(location, local_ip, remaining)
+                return _device_from_location(location, ip, remaining)
             except UPnPError as e:
                 last_err = e
         if last_err is not None:

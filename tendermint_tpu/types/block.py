@@ -176,13 +176,23 @@ class Commit:
                 raise ValueError("commit votes differ in height/round")
 
     def hash(self) -> bytes:
-        leaves = [encoding.cdumps(v.to_obj() if v else None)
-                  for v in self.precommits]
-        return merkle.root_host(leaves)
+        # cached: a commit is built complete and never mutated (VoteSet
+        # .make_commit / from_obj construct fresh instances), and the
+        # sync loop hashes the same commit for validate_basic + header
+        # checks + store meta — O(V) encodes each time at V validators
+        if "_hash" not in self.__dict__:
+            leaves = [encoding.cdumps(v.to_obj() if v else None)
+                      for v in self.precommits]
+            self.__dict__["_hash"] = merkle.root_host(leaves)
+        return self.__dict__["_hash"]
 
     def to_obj(self):
-        return {"block_id": self.block_id.to_obj(),
-                "precommits": [v.to_obj() if v else None for v in self.precommits]}
+        if "_obj" not in self.__dict__:
+            self.__dict__["_obj"] = {
+                "block_id": self.block_id.to_obj(),
+                "precommits": [v.to_obj() if v else None
+                               for v in self.precommits]}
+        return self.__dict__["_obj"]
 
     @classmethod
     def from_obj(cls, o):

@@ -10,6 +10,7 @@ batch), the north-star workload of BASELINE.json.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -17,6 +18,8 @@ from tendermint_tpu.ops import merkle
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.keys import PubKey, address_of
 from tendermint_tpu.types.vote import VoteType
+
+_address_memo = functools.lru_cache(maxsize=65536)(address_of)
 
 
 @dataclass
@@ -27,12 +30,11 @@ class Validator:
 
     @property
     def address(self) -> bytes:
-        # cached: proposer rotation compares addresses O(V) times per
-        # height and hashing the pubkey each time dominated the loop
-        if self.__dict__.get("_addr_pk") is not self.pubkey:
-            self.__dict__["_addr"] = address_of(self.pubkey)
-            self.__dict__["_addr_pk"] = self.pubkey
-        return self.__dict__["_addr"]
+        # memoized ACROSS copies: ValidatorSet construction re-sorts by
+        # address and state bookkeeping copies the set several times per
+        # block, so a per-instance cache still rehashed every pubkey on
+        # each copy (~10 set copies x V hashes per block in fast-sync)
+        return _address_memo(self.pubkey)
 
     def copy(self) -> "Validator":
         return Validator(self.pubkey, self.voting_power, self.accum)
@@ -72,6 +74,7 @@ class ValidatorSet:
         # new set), so the map cannot go stale.
         self._index = {a: i for i, a in enumerate(addrs)}
         self._proposer: Optional[Validator] = None
+        self._hash: Optional[bytes] = None
 
     def __len__(self) -> int:
         return len(self.validators)
@@ -79,6 +82,7 @@ class ValidatorSet:
     def copy(self) -> "ValidatorSet":
         vs = ValidatorSet(self.validators)
         vs._proposer = self._proposer.copy() if self._proposer else None
+        vs._hash = self._hash
         return vs
 
     def total_voting_power(self) -> int:
@@ -117,10 +121,17 @@ class ValidatorSet:
     # -- hashing ------------------------------------------------------------
 
     def hash(self) -> bytes:
-        leaves = [encoding.cdumps(
-            {"pubkey": v.pubkey.hex(), "voting_power": v.voting_power})
-            for v in self.validators]
-        return merkle.root_host(leaves)
+        """Merkle root over (pubkey, power) leaves. Cached: membership
+        and powers never mutate in place (update_with_changes builds a
+        NEW set; increment_accum only moves accums, which are excluded
+        from the hash) — and callers hash the same set per header
+        (lite certify does so 3x per header)."""
+        if self._hash is None:
+            leaves = [encoding.cdumps(
+                {"pubkey": v.pubkey.hex(), "voting_power": v.voting_power})
+                for v in self.validators]
+            self._hash = merkle.root_host(leaves)
+        return self._hash
 
     def to_obj(self):
         return {"validators": [v.to_obj() for v in self.validators]}

@@ -405,3 +405,17 @@ def test_height_vote_set_gap_rounds_do_not_burn_allowance():
     v15 = signed_vote(privs[2], 2, 1, 15, VoteType.PRECOMMIT, bid)
     assert hvs.add_vote(v12, peer_id="peerA")
     assert hvs.add_vote(v15, peer_id="peerA")
+
+
+def test_vote_sign_bytes_fast_path():
+    """Vote.sign_bytes emits canonical JSON directly (hot path); it must
+    stay byte-identical to the generic canonical encoder over sign_obj,
+    including exotic chain ids needing JSON escapes."""
+    from tendermint_tpu.types import encoding
+
+    for cid in ("test-chain", 'quote"backslash\\', "unicode-ü-λ", ""):
+        for bid in (make_block_id(), BlockID()):
+            v = Vote(validator_address=b"\x01" * 20, validator_index=3,
+                     height=7, round=2, timestamp_ns=123456789,
+                     type=VoteType.PRECOMMIT, block_id=bid)
+            assert v.sign_bytes(cid) == encoding.cdumps(v.sign_obj(cid))
