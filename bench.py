@@ -19,11 +19,12 @@ import os
 import sys
 import time
 
-# persistent XLA compilation cache: the fused pallas kernel costs minutes
-# per shape on remote-compile setups; cache survives process restarts
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
+# persistent XLA compilation cache (TPU only — the fused pallas kernel
+# costs minutes per shape on remote-compile setups; on CPU the cache is
+# actively harmful, see bench_util.enable_tpu_compilation_cache)
+from bench_util import enable_tpu_compilation_cache
+
+enable_tpu_compilation_cache()
 
 
 def scalar_baseline_rate(pubs, msgs, sigs, budget_s=3.0) -> float:

@@ -20,15 +20,9 @@ import os
 import sys
 import time
 
-# persistent XLA compilation cache — TPU backends only (TPU executables
-# serialize cheaply; on CPU the cache forces the pathological AOT
-# pipeline, see tests/conftest.py). The env decides before jax inits.
-if os.environ.get("PALLAS_AXON_POOL_IPS") or any(
-        p in os.environ.get("JAX_PLATFORMS", "") for p in ("tpu", "axon")):
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
+from bench_util import enable_tpu_compilation_cache
+
+enable_tpu_compilation_cache()  # must precede any jax import
 
 
 from bench_util import ScalarVerifier as _ScalarVerifier

@@ -23,15 +23,9 @@ import os
 import sys
 import time
 
-# persistent XLA compilation cache — TPU backends only (TPU executables
-# serialize cheaply; on CPU the cache forces the pathological AOT
-# pipeline, see tests/conftest.py). The env decides before jax inits.
-if os.environ.get("PALLAS_AXON_POOL_IPS") or any(
-        p in os.environ.get("JAX_PLATFORMS", "") for p in ("tpu", "axon")):
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
+from bench_util import enable_tpu_compilation_cache
+
+enable_tpu_compilation_cache()  # must precede any jax import
 
 
 from bench_util import fast_signer
@@ -107,24 +101,10 @@ def run(n_headers: int = 2000, n_vals: int = 64,
     fcs, valset = build_chain(n_headers, n_vals)
     build_s = time.perf_counter() - t0
 
-    # warmup must compile the SAME kernel shape the measured run uses:
-    # the verifier chunks at BATCH_CHUNK (8192) and pads the tail up to
-    # a power of two, so one warmup of exactly BATCH_CHUNK signatures
-    # covers every dispatch below
-    from tendermint_tpu.models.verifier import BATCH_CHUNK, default_verifier
-    warm = max(1, min(n_headers, BATCH_CHUNK // n_vals))
-    certify_chain(chain_id, fcs[:warm], trusted=valset)
-    # ... and the measured run's TAIL chunk, whose power-of-two bucket
-    # can be smaller than BATCH_CHUNK (e.g. 1025 headers x 64 -> tail 64)
-    tail_sigs = (n_headers * n_vals) % BATCH_CHUNK
-    if tail_sigs:
-        sh = fcs[0].signed_header
-        pcs = [p for p in sh.commit.precommits if p is not None]
-        items = [(valset.validators[p.validator_index].pubkey,
-                  p.sign_bytes(chain_id), p.signature)
-                 for _ in range(tail_sigs // len(pcs) + 1)
-                 for p in pcs][:tail_sigs]
-        default_verifier().verify(items)
+    # compile every kernel shape the measured certify will dispatch
+    # (full chunks + padded tail) BEFORE the timed region
+    from tendermint_tpu.models.verifier import default_verifier
+    default_verifier().warmup(n_headers * n_vals)
 
     t0 = time.perf_counter()
     certify_chain(chain_id, fcs, trusted=valset)

@@ -64,3 +64,21 @@ class ScalarVerifier:
 
     def verify_one(self, pub, msg, sig) -> bool:
         return self._verify(pub, msg, sig)
+
+
+def enable_tpu_compilation_cache() -> None:
+    """Point JAX at the repo-local .jax_cache — TPU backends ONLY.
+
+    Call BEFORE importing jax. TPU executables serialize cheaply, so
+    warm runs skip the 40-50s Mosaic compiles; on CPU the cache forces
+    XLA:CPU's pathological serializable-AOT pipeline (>400s + ~30GB
+    compiler RSS for SPMD programs — see tests/conftest.py), so a CPU
+    backend must never see the env var."""
+    import os
+    if os.environ.get("PALLAS_AXON_POOL_IPS") or any(
+            p in os.environ.get("JAX_PLATFORMS", "")
+            for p in ("tpu", "axon")):
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))

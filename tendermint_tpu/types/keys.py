@@ -4,7 +4,6 @@ is this rebuild's single hash primitive)."""
 
 from __future__ import annotations
 
-import functools
 import hashlib
 import os
 from dataclasses import dataclass
@@ -16,26 +15,14 @@ def address_of(pubkey: bytes) -> bytes:
     return hashlib.sha256(pubkey).digest()[:20]
 
 
-@functools.lru_cache(maxsize=65536)
-def _pubkey_of_seed(seed: bytes) -> bytes:
-    """Seed -> public key, memoized: the derivation is a pure-Python
-    point multiply (~ms), and PrivKey.pubkey sits on signing and test
-    hot paths that access it per call."""
-    return _ref.public_key(seed)
-
-
-@functools.lru_cache(maxsize=65536)
-def _sign_key_of_seed(seed: bytes):
-    """Seed -> OpenSSL signing key (None without `cryptography`).
-    OpenSSL signs in ~30us vs ~5ms for the pure-Python oracle — this is
-    what makes PrivValidator signing usable at real block rates."""
+def _openssl_key_class():
     try:
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
             Ed25519PrivateKey,
         )
+        return Ed25519PrivateKey
     except ImportError:
         return None
-    return Ed25519PrivateKey.from_private_bytes(seed)
 
 
 @dataclass(frozen=True)
@@ -70,13 +57,28 @@ class PrivKey:
 
     @property
     def pubkey(self) -> PubKey:
-        return PubKey(_pubkey_of_seed(self.seed))
+        # cached per INSTANCE (not a module-level memo: a global cache
+        # would retain raw seeds for the process lifetime, well past the
+        # owning key's). The derivation is a ~ms pure-Python point
+        # multiply and this property sits on signing/test hot paths.
+        pk = self.__dict__.get("_pub")
+        if pk is None:
+            pk = PubKey(_ref.public_key(self.seed))
+            self.__dict__["_pub"] = pk
+        return pk
 
     def sign(self, msg: bytes) -> bytes:
-        k = _sign_key_of_seed(self.seed)
-        if k is not None:
-            return k.sign(msg)  # bit-identical to the RFC 8032 oracle
-        return _ref.sign(self.seed, msg)
+        # OpenSSL signs in ~30us vs ~5ms for the pure-Python oracle,
+        # bit-identical output (Ed25519 signing is deterministic);
+        # the handle is cached per instance, same rationale as pubkey
+        k = self.__dict__.get("_osslk")
+        if k is None:
+            cls = _openssl_key_class()
+            if cls is None:
+                return _ref.sign(self.seed, msg)
+            k = cls.from_private_bytes(self.seed)
+            self.__dict__["_osslk"] = k
+        return k.sign(msg)
 
     def to_obj(self):
         return {"type": "ed25519", "value": self.seed.hex()}
