@@ -50,6 +50,10 @@ def main() -> int:
     from tendermint_tpu.ops import ed25519
     from tendermint_tpu.utils import ed25519_ref as ref
 
+    # second phase: catch a locally attached TPU jax auto-detected
+    # without any env marker (the pre-import call above covers axon)
+    enable_tpu_compilation_cache(jax)
+
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
     # deterministic synthetic 10k-validator commit
     pubs, msgs, sigs = [], [], []

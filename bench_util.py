@@ -66,19 +66,28 @@ class ScalarVerifier:
         return self._verify(pub, msg, sig)
 
 
-def enable_tpu_compilation_cache() -> None:
+def enable_tpu_compilation_cache(jax_module=None) -> None:
     """Point JAX at the repo-local .jax_cache — TPU backends ONLY.
 
-    Call BEFORE importing jax. TPU executables serialize cheaply, so
-    warm runs skip the 40-50s Mosaic compiles; on CPU the cache forces
-    XLA:CPU's pathological serializable-AOT pipeline (>400s + ~30GB
-    compiler RSS for SPMD programs — see tests/conftest.py), so a CPU
-    backend must never see the env var."""
+    TPU executables serialize cheaply, so warm runs skip the 40-50s
+    Mosaic compiles; on CPU the cache forces XLA:CPU's pathological
+    serializable-AOT pipeline (>400s + ~30GB compiler RSS for SPMD
+    programs — see tests/conftest.py), so a CPU backend must never see
+    the cache config.
+
+    Two phases: call with no argument BEFORE importing jax (env-marker
+    fast path for tunneled/axon setups), and again AFTER importing jax
+    passing the module (catches a locally attached TPU that jax
+    auto-detects without any env marker)."""
     import os
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    if jax_module is not None:
+        if jax_module.default_backend() == "tpu" and \
+                not jax_module.config.jax_compilation_cache_dir:
+            jax_module.config.update("jax_compilation_cache_dir", cache_dir)
+        return
     if os.environ.get("PALLAS_AXON_POOL_IPS") or any(
             p in os.environ.get("JAX_PLATFORMS", "")
             for p in ("tpu", "axon")):
-        os.environ.setdefault(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache"))
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)

@@ -1,0 +1,92 @@
+"""Codec micro-benchmarks (the reference's benchmarks/codec_test.go:16,
+which compares go-wire vs protobuf vs JSON on NodeInfo/Vote/Block).
+
+This framework has ONE deterministic encoding (canonical JSON,
+types/encoding.py) for both sign-bytes and persistence, so the
+interesting numbers are encode/decode rates of the hot types — Vote
+(per-message gossip), Commit (per-block), Block (part-set + store) —
+plus the specialized Vote.sign_bytes fast path vs the generic walk.
+
+Run: `python benchmarks/codec_bench.py` — prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench(fn, budget_s: float = 1.0) -> float:
+    """Calls/sec of fn under a time budget (>=2 passes)."""
+    fn()  # warm
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        fn()
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    from tendermint_tpu.types import PrivKey, encoding
+    from tendermint_tpu.types.block import Block, BlockID, Commit, Data, Header, PartSetHeader
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    key = PrivKey.generate(b"\x01" * 32)
+    bid = BlockID(b"\x22" * 32, PartSetHeader(2, b"\x33" * 32))
+    vote = Vote(key.pubkey.address, 0, 5, 0, 1000, VoteType.PRECOMMIT, bid)
+    vote.signature = key.sign(vote.sign_bytes("codec-bench"))
+
+    votes = []
+    for i in range(64):
+        v = Vote(key.pubkey.address, 0, 5, 0, 1000 + i,
+                 VoteType.PRECOMMIT, bid)
+        v.signature = key.sign(v.sign_bytes("codec-bench"))
+        votes.append(v)
+    commit = Commit(bid, list(votes))
+
+    header = Header(chain_id="codec-bench", height=5, time_ns=1,
+                    num_txs=8, validators_hash=b"\x44" * 32,
+                    app_hash=b"\x55" * 32)
+    block = Block(header=header, data=Data([b"tx-%d" % i for i in range(8)]),
+                  last_commit=commit)
+
+    vote_obj = vote.to_obj()
+    vote_bytes = encoding.cdumps(vote_obj)
+    commit_bytes = encoding.cdumps(commit.to_obj())
+    block_bytes = block.to_bytes()
+
+    def fresh_vote_encode():
+        # defeat the to_obj cache: measure the real encode cost
+        v = Vote(key.pubkey.address, 0, 5, 0, 1000, VoteType.PRECOMMIT,
+                 bid, vote.signature)
+        encoding.cdumps(v.to_obj())
+
+    results = {
+        "vote_sign_bytes_per_sec": bench(
+            lambda: vote.sign_bytes("codec-bench")),
+        "vote_sign_bytes_generic_per_sec": bench(
+            lambda: encoding.cdumps(vote.sign_obj("codec-bench"))),
+        "vote_encode_per_sec": bench(fresh_vote_encode),
+        "vote_decode_per_sec": bench(
+            lambda: Vote.from_obj(encoding.cloads(vote_bytes))),
+        "commit_decode_per_sec": bench(
+            lambda: Commit.from_obj(encoding.cloads(commit_bytes))),
+        "block_decode_per_sec": bench(
+            lambda: Block.from_bytes(block_bytes)),
+        "sizes_bytes": {"vote": len(vote_bytes),
+                        "commit_64": len(commit_bytes),
+                        "block_64c_8tx": len(block_bytes)},
+    }
+    print(json.dumps({"metric": "codec_bench", "results":
+                      {k: (round(v, 1) if isinstance(v, float) else v)
+                       for k, v in results.items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

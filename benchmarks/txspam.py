@@ -1,0 +1,63 @@
+"""WebSocket transaction load generator — the reference's
+benchmarks/simu/counter.go:14 (spams broadcast_tx over a websocket and
+measures sustained acceptance rate).
+
+Usage:
+    python benchmarks/txspam.py [host:port] [seconds]
+
+Connects one WSClient, fires `broadcast_tx_async` with unique kvstore
+txs as fast as the node accepts them for `seconds`, then reports txs/sec
+accepted and the node's height advance over the window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from tendermint_tpu.rpc.client import JSONRPCClient, WSClient
+
+    addr = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1:46657"
+    addr = addr.replace("ws://", "").replace("tcp://", "").split("/")[0]
+    host, port = addr.rsplit(":", 1)
+    budget_s = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+
+    http_url = f"http://{host}:{port}"
+    status = JSONRPCClient(http_url).call("status")
+    h0 = status["latest_block_height"]
+
+    ws = WSClient(host, int(port))
+    sent = accepted = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        tx = b"spam-%d=%d" % (sent, int(t0 * 1e6) + sent)
+        sent += 1
+        try:
+            res = ws.call("broadcast_tx_async", tx=tx.hex())
+            if res.get("code", 0) == 0:
+                accepted += 1
+        except Exception:
+            break
+    dt = time.perf_counter() - t0
+    ws.close()
+
+    h1 = JSONRPCClient(http_url).call("status")["latest_block_height"]
+    print(json.dumps({
+        "metric": "ws_tx_spam",
+        "value": round(accepted / dt, 1),
+        "unit": "txs/sec",
+        "extra": {"sent": sent, "accepted": accepted,
+                  "seconds": round(dt, 2),
+                  "height_advance": h1 - h0},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,11 +94,17 @@ class BatchVerifier:
         tail = n_sigs % BATCH_CHUNK
         if n_sigs > BATCH_CHUNK and tail:
             shapes.add(tail)
-        # garbage items exercise the same kernels: prepare marks them
-        # precheck-failed and ships zeroed scalars of identical shape
         for s in shapes:
+            # straight to the device path — self.verify would route tiny
+            # tails through the scalar backend and compile nothing.
+            # Zeroed items are canonical-length with s=0<L, so they run
+            # the full decompress+ladder (that's what makes the compile
+            # happen); the verdicts are discarded.
             items = [(b"\x00" * 32, b"", b"\x00" * 64)] * s
-            self.verify(items)
+            ed25519.verify_batch([it[0] for it in items],
+                                 [it[1] for it in items],
+                                 [it[2] for it in items],
+                                 kernel=self.kernel)
 
 
 _default: BatchVerifier | None = None

@@ -177,3 +177,41 @@ def test_no_unlock_on_older_round_votes():
     s.inject_vote(s.others[2], VoteType.PREVOTE, 0)
     assert cs.rs.locked_block is not None
     assert cs.rs.locked_block.hash() == bid.hash
+
+
+def test_halt_commits_from_older_round_on_late_precommit():
+    """TestStateHalt1 (consensus/state_test.go:1020): lock B in round 0
+    with precommits {ours: B, ext1: B, ext2: nil} (2/3-any, no maj),
+    advance to round 1 — then the WITHHELD round-0 precommit for B
+    arrives. Round 0 now has +2/3 precommits for B and the node must
+    commit B immediately, even though it sits in round 1."""
+    s = Script()
+    cs = s.cs
+    assert cs.rs.proposal_block is not None
+    bid = s.proposal_block_id()
+
+    # polka + lock in round 0 (2 ext prevotes + ours)
+    for k in s.others[:2]:
+        s.inject_vote(k, VoteType.PREVOTE, 0, bid)
+    assert cs.rs.locked_block is not None
+
+    # round-0 precommits: ext0 for B, ext1 nil (ours for B already in)
+    s.inject_vote(s.others[0], VoteType.PRECOMMIT, 0, bid)
+    s.inject_vote(s.others[1], VoteType.PRECOMMIT, 0)
+    cs.ticker.fire_next()  # precommit-wait -> round 1
+    assert cs.rs.round == 1
+    assert cs.state.last_block_height == 0  # nothing committed yet
+
+    if s.own_last(VoteType.PREVOTE, 1) is None:
+        cs.ticker.fire_next()  # propose timeout -> prevote locked B
+    pv1 = s.own_last(VoteType.PREVOTE, 1)
+    assert pv1 is not None and bytes.fromhex(pv1["block_id"]["hash"]) == bid.hash
+
+    # the late round-0 precommit: +2/3 for B at round 0 -> COMMIT
+    s.inject_vote(s.others[2], VoteType.PRECOMMIT, 0, bid)
+    # skip_timeout_commit may schedule a zero-delay NEW_HEIGHT tick
+    cs.ticker.fire_next()
+    assert cs.state.last_block_height == 1, (
+        f"node must halt-commit from round 0; at "
+        f"h={cs.rs.height} r={cs.rs.round} step={cs.rs.step.name}")
+    assert cs.state.last_block_id.hash == bid.hash
