@@ -109,6 +109,14 @@ def cmd_unsafe_reset_all(args) -> int:
     if os.path.isdir(data):
         shutil.rmtree(data)
         print(f"removed {data}")
+    return cmd_unsafe_reset_priv_validator(args)
+
+
+def cmd_unsafe_reset_priv_validator(args) -> int:
+    """Reset ONLY the double-sign protection state (the reference's
+    unsafe_reset_priv_validator, cmd reset_priv_validator.go) — for a
+    validator that must re-join after losing its state, accepting the
+    double-sign risk."""
     pv_path = os.path.join(args.home, "config", "priv_validator.json")
     if os.path.exists(pv_path):
         from tendermint_tpu.types import PrivValidatorFile
@@ -342,6 +350,8 @@ def main(argv=None) -> int:
     sub.add_parser("show_node_id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("gen_validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("unsafe_reset_all").set_defaults(fn=cmd_unsafe_reset_all)
+    sub.add_parser("unsafe_reset_priv_validator").set_defaults(
+        fn=cmd_unsafe_reset_priv_validator)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -87,8 +87,8 @@ class BatchVerifier:
         bucket). Benches call this so multi-minute device compiles never
         land inside a timed region; the chunking/bucketing knowledge
         stays here, next to the code that defines it."""
-        if n_sigs <= 0:
-            return
+        if n_sigs <= 0 or self.backend == "python":
+            return  # scalar backend compiles nothing
         from tendermint_tpu.ops import ed25519
         shapes = {min(BATCH_CHUNK, n_sigs)}
         tail = n_sigs % BATCH_CHUNK
