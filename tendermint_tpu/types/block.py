@@ -110,14 +110,30 @@ class Header:
             last_results_hash=bytes.fromhex(o["last_results_hash"]),
             evidence_hash=bytes.fromhex(o["evidence_hash"]))
 
+    def __setattr__(self, name, value):
+        # ANY field write invalidates the cached hash — headers are
+        # mutated during fill_header and by tamper-style tests; a stale
+        # hash here would be a consensus bug
+        if not name.startswith("_"):
+            self.__dict__.pop("_hash", None)
+        object.__setattr__(self, name, value)
+
     def hash(self) -> bytes:
         """Merkle root over sorted (field, value) leaves — the merkle-map of
-        types/block.go:178. Empty validators_hash => zero hash (unfilled)."""
+        types/block.go:178. Empty validators_hash => zero hash (unfilled).
+
+        Cached (invalidated by __setattr__ on any field write):
+        fast-sync/store/validate hash the same header several times per
+        block, and each hash is 13 canonical encodes + a Merkle tree."""
         if not self.validators_hash:
             return b""
-        obj = self.to_obj()
-        leaves = [encoding.cdumps({k: obj[k]}) for k in sorted(obj)]
-        return merkle.root_host(leaves)
+        h = self.__dict__.get("_hash")
+        if h is None:
+            obj = self.to_obj()
+            leaves = [encoding.cdumps({k: obj[k]}) for k in sorted(obj)]
+            h = merkle.root_host(leaves)
+            self.__dict__["_hash"] = h
+        return h
 
 
 @dataclass
@@ -225,7 +241,10 @@ class Block:
     last_commit: Commit = field(default_factory=Commit)
 
     def fill_header(self) -> None:
-        """Populate derived header hashes (types/block.go:74)."""
+        """Populate derived header hashes (types/block.go:74). Cache
+        invalidation is automatic: the field writes go through
+        Header.__setattr__ (dropping the header-hash cache), and the
+        block-bytes cache below is keyed on the header hash."""
         h = self.header
         if not h.last_commit_hash:
             h.last_commit_hash = self.last_commit.hash()
@@ -265,11 +284,29 @@ class Block:
                    Commit.from_obj(o["last_commit"]))
 
     def to_bytes(self) -> bytes:
-        return encoding.cdumps(self.to_obj())
+        # cached KEYED ON THE HEADER HASH: the sync loop serializes each
+        # block for the part set while the store serializes it again,
+        # and blocks parsed from the wire keep their original bytes for
+        # free. Header mutations auto-invalidate the header hash (its
+        # __setattr__), which invalidates this cache transitively — so
+        # tampering with a cached block cannot yield bytes that disagree
+        # with its hash. (Mutating data/evidence/last_commit WITHOUT the
+        # header changing was already an inconsistent block before any
+        # caching: the header's derived hashes would be stale.)
+        hh = self.header.hash()
+        if self.__dict__.get("_bytes_hh") == hh and                 self.__dict__.get("_bytes") is not None:
+            return self.__dict__["_bytes"]
+        b = encoding.cdumps(self.to_obj())
+        self.__dict__["_bytes"] = b
+        self.__dict__["_bytes_hh"] = hh
+        return b
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "Block":
-        return cls.from_obj(encoding.cloads(b))
+        blk = cls.from_obj(encoding.cloads(b))
+        blk.__dict__["_bytes"] = bytes(b)
+        blk.__dict__["_bytes_hh"] = blk.header.hash()
+        return blk
 
     def make_part_set(self, part_size: int):
         from tendermint_tpu.types.part_set import PartSet

@@ -16,16 +16,26 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+def _free_port_block(k):
+    """A base port such that base..base+k-1 are ALL currently bindable
+    (each node needs two consecutive ports; a single unchecked busy
+    port in the range would look like a consensus failure)."""
+    import random
+    for _ in range(50):
+        base = random.randrange(20000, 60000, 2) | 1
+        socks = []
+        try:
+            for off in range(k):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + off))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
 
 
 def _node_env():
@@ -42,7 +52,7 @@ def _node_env():
 def test_three_process_testnet_atomic_broadcast(tmp_path):
     net = str(tmp_path / "net")
     n = 3
-    base = _free_ports(1)[0] | 1  # odd base keeps the 2i/2i+1 scheme sane
+    base = _free_port_block(2 * n)
     r = subprocess.run(
         [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
          "--n", str(n), "--output", net, "--base-port", str(base),
@@ -140,7 +150,7 @@ def test_killed_node_fast_syncs_back(tmp_path):
     net = str(tmp_path / "net")
     n = 4  # kill 1 of 4: the rest hold 30/40 > 2/3 (2 of 3 would be
     # exactly 2/3, which is NOT a supermajority)
-    base = _free_ports(1)[0] | 1
+    base = _free_port_block(2 * n)
     r = subprocess.run(
         [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
          "--n", str(n), "--output", net, "--base-port", str(base),
