@@ -6,8 +6,11 @@ of the TPU batch-verify kernel in ops/ed25519.py. Cofactorless verification
 (s*B == R + h*A compared via canonical encodings) to match the behavior of
 the Go x/crypto implementation the reference depends on (SURVEY.md §2.9).
 
-Implemented from the RFC 8032 specification; independent of the reference
-codebase (which contains no crypto code of its own).
+Implemented from the RFC 8032 specification — the structure follows the
+normative sample code in RFC 8032 §6 (point_add letter naming,
+compress/decompress shape), which is the honest citation for any
+spec-faithful Python Ed25519. Independent of the reference codebase
+(which contains no crypto code of its own).
 """
 
 from __future__ import annotations
