@@ -4,13 +4,19 @@ Txs are "key=value" (or opaque bytes stored under themselves). The app hash
 is the Merkle root (ops/merkle) over sorted key=value leaves, so every
 committed height has a verifiable state commitment — what the reference's
 dummy app gets from its IAVL tree.
+
+Validator-change txs (the reference's persistent_dummy surface):
+`val:<pubkey_hex>/<power>` queues a validator update returned from
+EndBlock — power 0 removes the validator. This is how the reactor
+valset-change scenarios drive membership churn through consensus.
 """
 
 from __future__ import annotations
 
 from tendermint_tpu.abci.app import BaseApplication
 from tendermint_tpu.abci.types import (
-    ResultCheckTx, ResultDeliverTx, ResultInfo, ResultQuery,
+    ResultCheckTx, ResultDeliverTx, ResultEndBlock, ResultInfo,
+    ResultQuery, ValidatorUpdate,
 )
 from tendermint_tpu.ops import merkle
 
@@ -21,6 +27,7 @@ class KVStoreApp(BaseApplication):
         self.height = 0
         self.app_hash = b""
         self.tx_count = 0
+        self._val_updates: list[ValidatorUpdate] = []
 
     def info(self) -> ResultInfo:
         return ResultInfo(data=f"kvstore:{len(self.store)}",
@@ -36,6 +43,18 @@ class KVStoreApp(BaseApplication):
     def deliver_tx(self, tx: bytes) -> ResultDeliverTx:
         if not tx:
             return ResultDeliverTx(code=1, log="empty tx")
+        if tx.startswith(b"val:"):
+            try:
+                pk_hex, _, power = tx[4:].partition(b"/")
+                update = ValidatorUpdate(bytes.fromhex(pk_hex.decode()),
+                                         int(power))
+                if len(update.pubkey) != 32 or update.power < 0:
+                    raise ValueError(tx)
+            except (ValueError, UnicodeDecodeError):
+                return ResultDeliverTx(code=1, log=f"bad val tx {tx!r}")
+            self._val_updates.append(update)
+            self.tx_count += 1
+            return ResultDeliverTx(tags={"val": pk_hex.decode()[:16]})
         if b"=" in tx:
             k, _, v = tx.partition(b"=")
         else:
@@ -49,6 +68,10 @@ class KVStoreApp(BaseApplication):
         leaves = [k + b"=" + v for k, v in sorted(self.store.items())]
         self.app_hash = merkle.root_host(leaves) if leaves else b"\x00" * 32
         return self.app_hash
+
+    def end_block(self, height: int) -> ResultEndBlock:
+        updates, self._val_updates = self._val_updates, []
+        return ResultEndBlock(validator_updates=updates)
 
     def query(self, path: str, data: bytes, height: int,
               prove: bool) -> ResultQuery:

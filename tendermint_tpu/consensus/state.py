@@ -26,7 +26,8 @@ from typing import Callable, List, Optional
 from tendermint_tpu.config import ConsensusConfig
 from tendermint_tpu.consensus.rstate import HeightVoteSet, RoundState, Step
 from tendermint_tpu.consensus.ticker import MockTicker, TimeoutInfo, TimeoutTicker
-from tendermint_tpu.state.execution import BlockExecutor, MockEvidencePool, MockMempool
+from tendermint_tpu.state.execution import (ApplyBlockError, BlockExecutor,
+                                            MockEvidencePool, MockMempool)
 from tendermint_tpu.state.state import State
 from tendermint_tpu.state.validation import BlockValidationError
 from tendermint_tpu.storage.wal import NilWAL
@@ -71,6 +72,7 @@ class ConsensusState:
 
         self._lock = threading.RLock()
         self._queue: deque = deque()
+        self.fatal_error = None
         self._processing = False
         self._stopped = False
 
@@ -104,7 +106,17 @@ class ConsensusState:
                         self.wal.save(wal_obj, time_ns=time.time_ns())
                     try:
                         self._handle(m, p)
-                    except (ConsensusFailure, AssertionError):
+                    except (ConsensusFailure, AssertionError,
+                            ApplyBlockError) as e:
+                        # unrecoverable: HALT this state machine (the
+                        # reference's receiveRoutine panics the whole
+                        # process), record why, and propagate to the
+                        # driving thread. Without _stopped the next
+                        # input would re-execute the decided block on
+                        # the app — double DeliverTx side effects.
+                        self._stopped = True
+                        self.fatal_error = e
+                        self._log(f"CONSENSUS FAILURE, halting: {e!r}")
                         raise
                     except Exception as e:
                         self._log(f"error handling {m.get('type')}: {e!r}")

@@ -106,6 +106,11 @@ def exec_block_on_app(app_conn, block: Block,
     return ABCIResponses(deliver_txs, end.to_obj())
 
 
+class ApplyBlockError(RuntimeError):
+    """Unrecoverable failure applying a DECIDED block (the reference
+    panics: consensus/state.go:1214-1220 / execution error paths)."""
+
+
 class BlockExecutor:
     def __init__(self, state_store, app_conn_consensus,
                  mempool: Optional[Mempool] = None,
@@ -170,7 +175,15 @@ class BlockExecutor:
 def update_state(state: State, block_id: BlockID, block: Block,
                  responses: ABCIResponses) -> State:
     """state/execution.go:286-338: next State value (app_hash filled by
-    caller after app Commit)."""
+    caller after app Commit).
+
+    An invalid app-supplied update (e.g. removing an unknown validator)
+    raises ApplyBlockError — unrecoverable determinism loss for a
+    DECIDED block, not a bad block or peer message (the reference
+    panics on ApplyBlock errors). Wrapped HERE so every call site (live
+    apply AND handshake replay, consensus/replay.py) classifies it the
+    same way.
+    """
     h = block.header.height
     end = responses.end_block_obj
 
@@ -179,8 +192,12 @@ def update_state(state: State, block_id: BlockID, block: Block,
     updates = [ValidatorUpdate.from_obj(u)
                for u in end.get("validator_updates", [])]
     if updates:
-        validators = validators.update_with_changes(
-            [Validator(u.pubkey, u.power) for u in updates])
+        try:
+            validators = validators.update_with_changes(
+                [Validator(u.pubkey, u.power) for u in updates])
+        except ValueError as e:
+            raise ApplyBlockError(
+                f"validator update failed at height {h}: {e}") from e
         last_height_vals_changed = h + 1
 
     params = state.consensus_params

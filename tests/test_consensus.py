@@ -41,6 +41,37 @@ def make_node(gen_doc, key=None, app=None):
     return cs
 
 
+class ListMempool:
+    """Minimal reap/update mempool for proposer-side tx injection."""
+
+    def __init__(self):
+        self.txs = []
+
+    def lock(self): pass
+
+    def unlock(self): pass
+
+    def size(self): return len(self.txs)
+
+    def reap(self, mx): return self.txs[:mx]
+
+    def update(self, height, txs):
+        self.txs = [t for t in self.txs if t not in txs]
+
+    def flush(self): pass
+
+
+def wire_full_mesh(nodes):
+    """Relay proposal/part/vote broadcasts to every other node."""
+    for i, src_node in enumerate(nodes):
+        def relay(msg, i=i):
+            for j, dst in enumerate(nodes):
+                if j != i and msg["type"] in ("proposal", "block_part",
+                                              "vote"):
+                    dst.submit(dict(msg), peer_id=f"node{i}")
+        src_node.broadcast_hooks.append(relay)
+
+
 def make_net(n, chain_id="cs-test"):
     keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
     gen = GenesisDoc(chain_id=chain_id, genesis_time_ns=1,
@@ -111,17 +142,6 @@ def test_net_with_txs_delivers_to_all_apps():
                      validators=[GenesisValidator(k.pubkey.ed25519, 10)
                                  for k in keys])
 
-    class ListMempool:
-        def __init__(self):
-            self.txs = []
-        def lock(self): pass
-        def unlock(self): pass
-        def size(self): return len(self.txs)
-        def reap(self, mx): return self.txs[:mx]
-        def update(self, height, txs):
-            self.txs = [t for t in self.txs if t not in txs]
-        def flush(self): pass
-
     nodes = []
     mempools = []
     for k in keys:
@@ -132,12 +152,7 @@ def test_net_with_txs_delivers_to_all_apps():
         node.mempool = mp
         mempools.append(mp)
         nodes.append(node)
-    for i, src in enumerate(nodes):
-        def relay(msg, i=i):
-            for j, dst in enumerate(nodes):
-                if j != i and msg["type"] in ("proposal", "block_part", "vote"):
-                    dst.submit(dict(msg), peer_id=f"node{i}")
-        src.broadcast_hooks.append(relay)
+    wire_full_mesh(nodes)
 
     for mp in mempools:
         mp.txs = [b"alpha=1", b"beta=2"]
@@ -177,3 +192,79 @@ def test_round_advances_without_proposer():
         n.start()
     run_until_height(live, 1, max_ticks=600)
     assert all(n.state.last_block_height >= 1 for n in live)
+
+
+def test_validator_set_changes_through_end_block():
+    """The reference's TestReactorValidatorSetChanges core: a `val:` tx
+    committed through consensus changes the validator set via EndBlock —
+    a power change lands in state.validators at the NEXT height, a
+    power-0 update removes the validator, and the net keeps committing
+    with the new set throughout."""
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    gen = GenesisDoc(chain_id="valchange-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+
+    nodes, mempools = [], []
+    for k in keys:
+        node = make_node(gen, k)
+        mp = ListMempool()
+        node.mempool = mp
+        node.block_exec.mempool = mp  # so committed txs leave the pool
+        mempools.append(mp)
+        nodes.append(node)
+    wire_full_mesh(nodes)
+
+    # raise validator 0's power 10 -> 30
+    target = keys[0].pubkey
+    bump = b"val:" + target.ed25519.hex().encode() + b"/30"
+    for mp in mempools:
+        mp.txs = [bump]
+    for n in nodes:
+        n.start()
+    run_until_height(nodes, 3)
+
+    for n in nodes:
+        _, val = n.state.validators.get_by_address(target.address)
+        assert val is not None and val.voting_power == 30, \
+            (n.state.last_block_height, val)
+    assert all(n.state.validators.total_voting_power() == 60 for n in nodes)
+
+    # now remove validator 3 entirely (power 0); remaining power 50/60
+    # of the CURRENT set still commits, and the set shrinks to 3
+    gone = keys[3].pubkey
+    drop = b"val:" + gone.ed25519.hex().encode() + b"/0"
+    for mp in mempools:
+        mp.txs = [drop]
+    h = nodes[0].state.last_block_height
+    run_until_height(nodes, h + 2)
+    for n in nodes:
+        assert len(n.state.validators) == 3
+        assert not n.state.validators.has_address(gone.address)
+    # ...and the 3-validator set keeps committing (incl. node3, now a
+    # non-validator full node)
+    h = nodes[0].state.last_block_height
+    run_until_height(nodes, h + 1)
+
+
+def test_invalid_app_validator_update_fails_loudly():
+    """An app emitting an invalid update (removing an unknown validator)
+    must raise ApplyBlockError — NOT a ValueError that vote handlers
+    would swallow while the node stalls silently in COMMIT (the
+    reference panics on ApplyBlock errors)."""
+    from tendermint_tpu.state.execution import ApplyBlockError
+
+    from tendermint_tpu.abci.types import ValidatorUpdate
+
+    key = PrivKey.generate(b"\x01" * 32)
+    gen = GenesisDoc(chain_id="loud-fail", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    app = KVStoreApp()
+    cs = make_node(gen, key, app=app)
+    nodes = [cs]
+    # the app drops an unknown validator at height 1
+    ghost = PrivKey.generate(b"\x77" * 32).pubkey
+    app._val_updates.append(ValidatorUpdate(ghost.ed25519, 0))
+    cs.start()
+    with pytest.raises(ApplyBlockError):
+        run_until_height(nodes, 1, max_ticks=30)
