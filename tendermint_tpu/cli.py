@@ -70,6 +70,16 @@ def cmd_node(args) -> int:
         deadline = time.time() + args.max_seconds if args.max_seconds else None
         while True:
             time.sleep(0.2)
+            fatal = node.consensus.fatal_error or getattr(
+                getattr(node, "blockchain_reactor", None), "sync_error",
+                None)
+            if fatal is not None:
+                # consensus OR fast-sync halted unrecoverably (the
+                # reference panics): die loudly rather than sit at a
+                # frozen height
+                print(f"CONSENSUS FAILURE: {fatal!r}", flush=True)
+                node.stop()
+                return 1
             if node.height != last:
                 last = node.height
                 print(f"committed height={last} "
