@@ -203,6 +203,30 @@ class ConsensusReactor(Reactor):
                                 msg["vote_type"], msg["index"])
             elif t == "commit_step":
                 ps.set_has_proposal(msg["parts_total"])
+            elif t == "heartbeat":
+                # liveness signal from a validator waiting for txs:
+                # verify it really is that validator before surfacing on
+                # the event bus (the reference publishes
+                # EventProposalHeartbeat); no state-machine input
+                if self.cs.event_bus is None:
+                    return
+                from tendermint_tpu.types.proposal import Heartbeat
+                try:
+                    hb = Heartbeat.from_obj(msg["heartbeat"])
+                except (KeyError, ValueError, TypeError):
+                    return  # malformed: drop
+                idx, val = self.cs.rs.validators.get_by_address(
+                    hb.validator_address)
+                if val is None or idx != hb.validator_index:
+                    return  # not a current validator: drop
+                from tendermint_tpu.types.keys import PubKey
+                if not PubKey(val.pubkey).verify(
+                        hb.sign_bytes(self.cs.state.chain_id),
+                        hb.signature):
+                    return  # forged: drop
+                self.cs.event_bus.publish(
+                    "ProposalHeartbeat", {"heartbeat": hb.to_obj(),
+                                          "peer": peer.id})
             elif t == "vote_set_maj23":
                 # peer claims +2/3 for a block: record + reply with our bits
                 if self.fast_sync:
@@ -277,6 +301,11 @@ class ConsensusReactor(Reactor):
                 "type": "has_vote", "height": msg["height"],
                 "round": msg["round"], "vote_type": msg["vote_type"],
                 "index": msg["index"]})
+        elif t == "heartbeat":
+            # proposal heartbeat while waiting for txs
+            # (consensus/reactor.go ProposalHeartbeatMessage)
+            self.switch.broadcast_obj(STATE_CHANNEL, {
+                "type": "heartbeat", "heartbeat": msg["heartbeat"]})
 
     # -------------------------------------------------------- gossip: data
 

@@ -34,7 +34,7 @@ from tendermint_tpu.storage.wal import NilWAL
 from tendermint_tpu.types.block import Block, BlockID, PartSetHeader
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 from tendermint_tpu.types.part_set import Part, PartSet
-from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.proposal import Heartbeat, Proposal
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote, VoteType
 from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSet
@@ -317,12 +317,37 @@ class ConsensusState:
         wait_for_txs = (not self.config.create_empty_blocks and round_ == 0
                         and not self._need_proof_block(height))
         if wait_for_txs:
+            self._send_proposal_heartbeat(height, round_)
             if self.config.create_empty_blocks_interval > 0:
                 self._schedule_timeout(
                     self.config.create_empty_blocks_interval,
                     height, round_, Step.NEW_ROUND)
         else:
             self._enter_propose(height, round_)
+
+    def _send_proposal_heartbeat(self, height: int, round_: int) -> None:
+        """Signed liveness signal while waiting for transactions
+        (consensus/state.go:696,713 proposalHeartbeat). Divergence: the
+        reference loops one heartbeat every 2s for the whole wait; this
+        sends one per (height, round) wait entry — liveness is signalled
+        when the wait starts, and peers learn the round from the normal
+        new_round_step gossip thereafter (a repeating timer would need a
+        second ticker slot for no additional information)."""
+        if self.priv_validator is None:
+            return
+        rs = self.rs
+        addr = self.priv_validator.address
+        idx, _ = rs.validators.get_by_address(addr)
+        if idx < 0:
+            return
+        hb = Heartbeat(addr, idx, height, round_, sequence=0)
+        try:
+            self.priv_validator.sign_heartbeat(self.state.chain_id, hb)
+        except Exception as e:
+            self._log(f"error signing heartbeat: {e!r}")
+            return
+        self._publish("ProposalHeartbeat", {"heartbeat": hb.to_obj()})
+        self._broadcast({"type": "heartbeat", "heartbeat": hb.to_obj()})
 
     def _need_proof_block(self, height: int) -> bool:
         if height == 1:

@@ -268,3 +268,38 @@ def test_invalid_app_validator_update_fails_loudly():
     cs.start()
     with pytest.raises(ApplyBlockError):
         run_until_height(nodes, 1, max_ticks=30)
+
+
+def test_heartbeat_sent_while_waiting_for_txs():
+    """With create_empty_blocks=False a validator entering the wait
+    broadcasts a SIGNED proposal heartbeat (consensus/state.go:696
+    proposalHeartbeat) instead of proposing, and proposes only when
+    txs_available fires."""
+    key = PrivKey.generate(b"\x05" * 32)
+    gen = GenesisDoc(chain_id="hb-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    cs = make_node(gen, key)
+    cs.config.create_empty_blocks = False
+    mp = ListMempool()
+    cs.mempool = mp
+    cs.block_exec.mempool = mp
+    sent = []
+    cs.broadcast_hooks.append(
+        lambda m: sent.append(m) if m.get("type") == "heartbeat" else None)
+    cs.start()
+    # heights 1-2 are proof blocks (the app hash settles after the
+    # first commit), so the wait starts at height 3
+    run_until_height([cs], 2)
+    for _ in range(5):
+        cs.ticker.fire_next()
+    assert cs.state.last_block_height == 2, "must WAIT with no txs"
+    assert sent, "no heartbeat broadcast while waiting for txs"
+    from tendermint_tpu.types.proposal import Heartbeat
+    hb = Heartbeat.from_obj(sent[-1]["heartbeat"])
+    assert hb.height == 3
+    assert key.pubkey.verify(hb.sign_bytes("hb-test"), hb.signature)
+    # txs arrive -> propose + commit height 3
+    mp.txs = [b"wake=up"]
+    cs.submit({"type": "txs_available"})
+    run_until_height([cs], 3)
+    assert cs.state.last_block_height >= 3

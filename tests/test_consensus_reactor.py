@@ -139,3 +139,57 @@ def test_reactor_net_with_txs_converges_app_state():
         assert len(app_hashes) == 1
     finally:
         shutdown(reactors, switches)
+
+
+def test_heartbeat_receive_verifies_signature():
+    """Received proposal heartbeats are signature- and membership-
+    checked before reaching the event bus: forged or non-validator
+    heartbeats are dropped silently."""
+    from tendermint_tpu.types import encoding
+    from tendermint_tpu.types.events import EventBus
+    from tendermint_tpu.types.proposal import Heartbeat
+
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(2)]
+    gen = GenesisDoc(chain_id="hb-rx", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    cs = make_validator_node(gen, keys[0])
+    bus = EventBus()
+    cs.event_bus = bus
+    reactor = ConsensusReactor(cs)
+    sub = bus.subscribe("hb-test", "tm.event='ProposalHeartbeat'")
+
+    def got():
+        out = []
+        while not sub.queue.empty():
+            out.append(sub.queue.get_nowait())
+        return out
+
+    class FakePeer:
+        id = "fakepeer"
+        running = True
+        def set(self, k, v): pass
+        def try_send_obj(self, ch, obj): return True
+
+    peer = FakePeer()
+    reactor.peer_states[peer.id] = __import__(
+        "tendermint_tpu.consensus.reactor",
+        fromlist=["PeerRoundState"]).PeerRoundState()
+
+    idx, _ = cs.rs.validators.get_by_address(keys[1].pubkey.address)
+    hb = Heartbeat(keys[1].pubkey.address, idx, cs.rs.height, 0, 0)
+    hb.signature = keys[1].sign(hb.sign_bytes("hb-rx"))
+    msg = {"type": "heartbeat", "heartbeat": hb.to_obj()}
+    reactor.receive(0x20, peer, encoding.cdumps(msg))
+    assert len(got()) == 1, "valid heartbeat must publish"
+
+    forged = Heartbeat(keys[1].pubkey.address, idx, cs.rs.height, 0, 0,
+                       signature=b"\x01" * 64)
+    reactor.receive(0x20, peer, encoding.cdumps(
+        {"type": "heartbeat", "heartbeat": forged.to_obj()}))
+    ghost = PrivKey.generate(b"\x66" * 32)
+    outsider = Heartbeat(ghost.pubkey.address, 0, cs.rs.height, 0, 0)
+    outsider.signature = ghost.sign(outsider.sign_bytes("hb-rx"))
+    reactor.receive(0x20, peer, encoding.cdumps(
+        {"type": "heartbeat", "heartbeat": outsider.to_obj()}))
+    assert not got(), "forged/non-validator heartbeats must drop"
