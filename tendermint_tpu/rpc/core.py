@@ -158,8 +158,22 @@ class RPCCore:
                         if self.env.gen_doc else None})
 
     def dump_consensus_state(self) -> dict:
+        """rpc/core/consensus.go DumpConsensusState: our round state +
+        what we know of every peer's round state."""
         cs = self.env.consensus
         rs = cs.rs
+        peer_states = {}
+        if self.env.switch is not None:
+            reactor = self.env.switch.reactors.get("consensus")
+            for pid, ps in getattr(reactor, "peer_states", {}).items():
+                (h, r, step, has_prop, parts,
+                 last_commit_round) = ps.snapshot()
+                peer_states[pid] = {
+                    "height": h, "round": r, "step": step,
+                    "has_proposal": has_prop,
+                    "proposal_parts": sorted(parts),
+                    "last_commit_round": last_commit_round,
+                }
         return jsonify({
             "round_state": {
                 "height": rs.height, "round": rs.round,
@@ -171,6 +185,7 @@ class RPCCore:
                 "validators":
                     rs.validators.to_obj() if rs.validators else None,
             },
+            "peer_round_states": peer_states,
         })
 
     # ------------------------------------------------------------ blockchain

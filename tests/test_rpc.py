@@ -157,6 +157,7 @@ def test_dump_consensus_state_and_net_info(rpc_node):
     c = client(rpc_node)
     dcs = c.call("dump_consensus_state")
     assert dcs["round_state"]["height"] >= 1
+    assert "peer_round_states" in dcs  # {} here: no p2p in this node
     ni = c.call("net_info")
     assert ni["listening"] is False  # no p2p in this node
 
