@@ -21,6 +21,12 @@ class BaseConfig:
     db_dir: str = "data"
     log_level: str = "info"
     prof_laddr: str = ""
+    # signature-verification plane (no reference equivalent — the
+    # reference verifies scalar on one core, types/validator_set.go:257):
+    # backend auto|jax|python; mesh auto|off|N shards verify batches over
+    # the device mesh (models/verifier.py)
+    verifier_backend: str = "auto"
+    verifier_mesh: str = "auto"
 
 
 @dataclass

@@ -19,13 +19,19 @@ Backends:
              SURVEY.md §7 calls for: scalar for interactive single votes,
              batch for commits/fast-sync/lite).
 
-A sharded multi-chip kernel (parallel/mesh.py) can be injected via
-`kernel=` for mesh deployments.
+Multi-chip: `mesh="auto"` (the default via TM_TPU_MESH / config
+`base.verifier_mesh`) makes the verifier shard its batches over every
+available device with parallel/mesh.py's shard_map kernel — resolved
+LAZILY on the first jax-path dispatch so scalar verifies never pay jax
+backend init, and a no-op when only one device exists. `mesh=N` forces
+an N-device mesh; `mesh="off"` disables sharding. A pre-built kernel can
+still be injected via `kernel=` (tests, bespoke meshes).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Sequence
 
 import numpy as np
@@ -37,14 +43,91 @@ import numpy as np
 BATCH_CHUNK = 8192
 
 
+# sharded kernels cached per device count: each sharded_verify_kernel()
+# call returns a fresh jit closure with its own compile cache, and on the
+# 1-core CI host every extra compile is minutes — one kernel per mesh
+# size is shared by all verifiers in the process
+_mesh_kernels: dict[int, Callable] = {}
+_mesh_lock = threading.Lock()
+
+
+def _mesh_kernel(n_devices: int) -> Callable:
+    with _mesh_lock:
+        if n_devices not in _mesh_kernels:
+            from tendermint_tpu.parallel.mesh import (make_mesh,
+                                                      sharded_verify_kernel)
+            _mesh_kernels[n_devices] = sharded_verify_kernel(
+                make_mesh(n_devices))
+        return _mesh_kernels[n_devices]
+
+
+def _parse_mesh_spec(mesh: str) -> str | int:
+    """'auto' | 'off' | power-of-two int. Raises ValueError on anything
+    else — callers (Node.__init__) validate the config knob eagerly so a
+    typo fails at startup, not at the first batched verify where callers'
+    `except ValueError` handlers would misread it as bad peer data."""
+    s = str(mesh).strip().lower()
+    if s in ("auto", ""):
+        return "auto"
+    if s in ("off", "0", "1", "none"):
+        return "off"
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"verifier mesh must be auto|off|N, got {mesh!r}") from None
+    if n < 2 or n & (n - 1):
+        raise ValueError(
+            f"verifier mesh size must be a power of two >= 2, got {n}")
+    return n
+
+
 class BatchVerifier:
     def __init__(self, backend: str = "auto", auto_threshold: int = 4,
-                 kernel: Callable | None = None):
+                 kernel: Callable | None = None, mesh: str = "off"):
         assert backend in ("auto", "jax", "python")
         self.backend = backend
         self.auto_threshold = auto_threshold
         self.kernel = kernel
+        self.mesh = _parse_mesh_spec(mesh)
+        self.mesh_devices = 0          # >0 once a sharded kernel is active
+        self._min_bucket = 8
+        self._mesh_resolved = kernel is not None or self.mesh == "off"
+        self._resolve_lock = threading.Lock()
         self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}
+
+    def _resolve_mesh(self) -> None:
+        """Build the sharded kernel on first device dispatch. mesh='auto'
+        uses the largest power-of-two device count (shard_map needs the
+        padded batch axis divisible by the mesh; buckets are powers of
+        two); single-device hosts get the plain kernel. Thread-safe:
+        concurrent verify() calls (reactor windows, evidence, RPC) must
+        not dispatch with a half-initialized kernel/bucket pair."""
+        with self._resolve_lock:
+            if self._mesh_resolved:
+                return
+            import jax
+            try:
+                n_avail = len(jax.devices())
+            except Exception:
+                # no usable backend; plain kernel path will surface it
+                self._mesh_resolved = True
+                return
+            if self.mesh == "auto":
+                n = 1
+                while n * 2 <= n_avail:
+                    n *= 2
+            else:
+                n = self.mesh
+                if n > n_avail:
+                    raise RuntimeError(
+                        f"verifier mesh={n} but only {n_avail} "
+                        f"devices present")
+            if n >= 2:
+                self.kernel = _mesh_kernel(n)
+                self.mesh_devices = n
+                self._min_bucket = max(8, n)
+            self._mesh_resolved = True
 
     def verify(self, items: Sequence[tuple[bytes, bytes, bytes]]) -> np.ndarray:
         """items: (pubkey32, message, signature64) triples -> bool[N]."""
@@ -59,6 +142,8 @@ class BatchVerifier:
             from tendermint_tpu.utils import ed25519_ref as ref
             return np.array([ref.verify(p, m, s) for p, m, s in items], np.bool_)
         from tendermint_tpu.ops import ed25519
+        if not self._mesh_resolved:
+            self._resolve_mesh()
         self.stats["jax_sigs"] += n
         pubkeys = [it[0] for it in items]
         msgs = [it[1] for it in items]
@@ -71,7 +156,8 @@ class BatchVerifier:
         for lo in range(0, n, BATCH_CHUNK):
             hi = min(lo + BATCH_CHUNK, n)
             res, pre = ed25519.verify_batch_async(
-                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel)
+                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel,
+                min_bucket=self._min_bucket)
             pending.append((lo, hi, res, pre))
         out = np.zeros(n, np.bool_)
         for lo, hi, res, pre in pending:
@@ -94,6 +180,8 @@ class BatchVerifier:
         tail = n_sigs % BATCH_CHUNK
         if n_sigs > BATCH_CHUNK and tail:
             shapes.add(tail)
+        if not self._mesh_resolved:
+            self._resolve_mesh()
         for s in shapes:
             # straight to the device path — self.verify would route tiny
             # tails through the scalar backend and compile nothing.
@@ -104,17 +192,22 @@ class BatchVerifier:
             ed25519.verify_batch([it[0] for it in items],
                                  [it[1] for it in items],
                                  [it[2] for it in items],
-                                 kernel=self.kernel)
+                                 kernel=self.kernel,
+                                 min_bucket=self._min_bucket)
 
 
 _default: BatchVerifier | None = None
 
 
 def default_verifier() -> BatchVerifier:
-    """Process-wide verifier; backend from TM_TPU_VERIFIER (auto|jax|python)."""
+    """Process-wide verifier; backend from TM_TPU_VERIFIER (auto|jax|python),
+    mesh from TM_TPU_MESH (auto|off|N, default auto — a node on a
+    multi-device host shards its signature batches over every chip with
+    zero code changes)."""
     global _default
     if _default is None:
-        _default = BatchVerifier(os.environ.get("TM_TPU_VERIFIER", "auto"))
+        _default = BatchVerifier(os.environ.get("TM_TPU_VERIFIER", "auto"),
+                                 mesh=os.environ.get("TM_TPU_MESH", "auto"))
     return _default
 
 

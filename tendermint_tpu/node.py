@@ -75,18 +75,34 @@ class Node:
                          else config.path(config.mempool.wal_dir)))
         self.mempool = mempool
 
+        # verification plane: the process-wide verifier unless the config
+        # asks for a non-default backend/mesh (config knob per VERDICT r2
+        # — a node on a multi-device host shards over every chip via
+        # mesh="auto"; mesh kernels are cached per size so several
+        # in-process nodes share one compiled kernel). Built before the
+        # evidence pool so every verification path in the node uses the
+        # SAME configured verifier.
+        from tendermint_tpu.models.verifier import (BatchVerifier,
+                                                    default_verifier)
+        vb = getattr(config.base, "verifier_backend", "auto")
+        vm = str(getattr(config.base, "verifier_mesh", "auto"))
+        if (vb, vm) == ("auto", "auto"):
+            self.verifier = default_verifier()
+        else:
+            self.verifier = BatchVerifier(vb, mesh=vm)
+
         if evidence_pool is None:
             from tendermint_tpu.evidence import EvidencePool, EvidenceStore
             evidence_pool = EvidencePool(
                 EvidenceStore(open_db(db_path("evidence"))), state,
-                state_store=self.state_store)
+                state_store=self.state_store, verifier=self.verifier)
         self.evidence_pool = evidence_pool
 
         self.event_bus = EventBus()
         self.block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus,
             mempool=mempool, evidence_pool=evidence_pool,
-            event_bus=self.event_bus)
+            event_bus=self.event_bus, verifier=self.verifier)
 
         if in_memory:
             from tendermint_tpu.storage.wal import NilWAL

@@ -216,7 +216,7 @@ def _bucket(n: int, min_size: int = 8) -> int:
     return b
 
 
-def verify_batch_async(pubkeys, msgs, sigs, kernel=None):
+def verify_batch_async(pubkeys, msgs, sigs, kernel=None, min_bucket=8):
     """Dispatch one padded batch WITHOUT blocking: returns
     (device_result, precheck bool[N]). jax dispatch is asynchronous, so
     a caller with several chunks can enqueue them all and let device
@@ -224,7 +224,9 @@ def verify_batch_async(pubkeys, msgs, sigs, kernel=None):
     per-call round-trip otherwise dominates end-to-end throughput."""
     n = len(pubkeys)
     pk, rb, s_bytes, h_bytes, pre = prepare_batch_bytes(pubkeys, msgs, sigs)
-    m = _bucket(n)
+    # min_bucket > 8 when a sharded mesh kernel needs the batch axis
+    # divisible by the mesh size (both are powers of two)
+    m = _bucket(n, min_size=min_bucket)
     args = (jnp.asarray(_pad_to(pk, m)), jnp.asarray(_pad_to(rb, m)),
             jnp.asarray(_pad_to(s_bytes, m)),
             jnp.asarray(_pad_to(h_bytes, m)))
@@ -237,7 +239,7 @@ def verify_batch_async(pubkeys, msgs, sigs, kernel=None):
     return res, pre
 
 
-def verify_batch(pubkeys, msgs, sigs, kernel=None) -> np.ndarray:
+def verify_batch(pubkeys, msgs, sigs, kernel=None, min_bucket=8) -> np.ndarray:
     """Verify N (pubkey, msg, sig) triples; returns bool[N].
 
     Batches are padded to power-of-two sizes so repeated calls hit the jit
@@ -246,5 +248,6 @@ def verify_batch(pubkeys, msgs, sigs, kernel=None) -> np.ndarray:
     n = len(pubkeys)
     if n == 0:
         return np.zeros(0, np.bool_)
-    res, pre = verify_batch_async(pubkeys, msgs, sigs, kernel=kernel)
+    res, pre = verify_batch_async(pubkeys, msgs, sigs, kernel=kernel,
+                                  min_bucket=min_bucket)
     return np.asarray(res)[:n] & pre

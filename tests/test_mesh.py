@@ -101,3 +101,92 @@ def test_verify_step_end_to_end():
                     jnp.asarray(hbits), jnp.asarray(digests), n)
     assert np.asarray(ok).all()
     assert np.asarray(root).tobytes() == merkle.root_host(leaves)
+
+
+# ----------------------------------------------- product path (VERDICT r2 #1)
+
+def test_batch_verifier_mesh_knob():
+    """BatchVerifier(mesh=...) builds the sharded kernel lazily and its
+    verdicts agree with the scalar oracle — the production multi-chip
+    wiring (models/verifier.py), not a bespoke kernel call."""
+    from tendermint_tpu.models.verifier import BatchVerifier
+
+    pubs, msgs, sigs = signed_batch(8, tamper={3})
+    items = list(zip(pubs, msgs, sigs))
+
+    v = BatchVerifier("jax", mesh="8")
+    assert v.kernel is None and v.mesh_devices == 0  # lazy until dispatch
+    ok = v.verify(items)
+    assert v.mesh_devices == 8 and v.kernel is not None
+    assert ok.tolist() == [i != 3 for i in range(8)]
+
+    # auto on this 8-device host also shards 8-wide (same cached kernel)
+    va = BatchVerifier("jax", mesh="auto")
+    assert va.verify(items).tolist() == ok.tolist()
+    assert va.mesh_devices == 8 and va.kernel is v.kernel
+
+    # off / single-chip spec -> plain kernel path
+    voff = BatchVerifier("jax", mesh="off")
+    assert voff.verify(items).tolist() == ok.tolist()
+    assert voff.mesh_devices == 0 and voff.kernel is None
+
+
+def test_batch_verifier_mesh_spec_errors():
+    from tendermint_tpu.models.verifier import BatchVerifier
+    # spec validation is eager (at construction, i.e. node startup) ...
+    with pytest.raises(ValueError):
+        BatchVerifier("jax", mesh="3")
+    with pytest.raises(ValueError):
+        BatchVerifier("jax", mesh="bogus")
+    # ... only the device-count check needs jax and stays lazy, and it
+    # raises RuntimeError, which no verify-path caller catches as a
+    # bad-input signal
+    with pytest.raises(RuntimeError):
+        BatchVerifier("jax", mesh="64")._resolve_mesh()
+
+
+def test_fast_sync_window_verifies_through_mesh():
+    """fast-sync's _sync_window drains its batched window through a
+    mesh-sharded BatchVerifier injected via BlockExecutor — the node
+    config path (base.verifier_mesh) on a multi-device host."""
+    from test_fast_sync import build_chain
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    from tendermint_tpu.blockchain import BlockchainReactor, BlockPool
+    from tendermint_tpu.models.verifier import BatchVerifier
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.storage import BlockStore, MemDB, StateStore
+    from tendermint_tpu.types import (GenesisDoc, GenesisValidator, PrivKey)
+
+    key = PrivKey.generate(b"\x2a" * 32)
+    gen = GenesisDoc(chain_id="mesh-fs", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    _, _, src_store, gen = build_chain(gen, key, 9)
+
+    conns = AppConns(local_client_creator(KVStoreApp()))
+    state_store = StateStore(MemDB())
+    store = BlockStore(MemDB())
+    state = state_store.load_or_genesis(gen)
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], gen.chain_id)
+    verifier = BatchVerifier("jax", mesh="8")
+    exec_ = BlockExecutor(state_store, conns.consensus, verifier=verifier)
+
+    reactor = BlockchainReactor(state, exec_, store, fast_sync=True,
+                                verify_window=16)
+    pool = BlockPool(start_height=1, send_request=lambda p, h: True,
+                     on_peer_error=lambda p, r: None)
+    reactor.pool = pool
+    pool.set_peer_height("src", src_store.height())
+    pool.make_next_requests()
+    for h in range(1, src_store.height() + 1):
+        assert pool.add_block("src", src_store.load_block(h), 100)
+
+    while reactor._sync_window():
+        pass
+    # synced to tip-1 (tip has no child commit in the window)
+    assert store.height() == src_store.height() - 1
+    assert verifier.mesh_devices == 8, "window did not use the mesh kernel"
+    assert verifier.stats["jax_sigs"] > 0
