@@ -9,6 +9,15 @@ Validator-change txs (the reference's persistent_dummy surface):
 `val:<pubkey_hex>/<power>` queues a validator update returned from
 EndBlock — power 0 removes the validator. This is how the reactor
 valset-change scenarios drive membership churn through consensus.
+
+The app tracks the active validator set (seeded from InitChain,
+maintained from applied updates) and REJECTS invalid updates at
+DeliverTx time — removal of an unknown validator, or a batch that would
+empty the set — mirroring persistent_dummy's updateValidator guard. The
+core treats an invalid EndBlock update as a consensus failure and
+halts, so the app must be the gate that keeps bad updates from ever
+reaching it: without this, one unauthenticated broadcast_tx naming an
+unknown pubkey with power 0 would halt the whole network.
 """
 
 from __future__ import annotations
@@ -28,6 +37,17 @@ class KVStoreApp(BaseApplication):
         self.app_hash = b""
         self.tx_count = 0
         self._val_updates: list[ValidatorUpdate] = []
+        # pubkey -> power of the ACTIVE set, as the app knows it: seeded
+        # by init_chain, advanced immediately by its own accepted updates
+        # (persistent_dummy mutates app state at DeliverTx time too, so
+        # several val txs in one block see each other's effects)
+        self._validators: dict[bytes, int] = {}
+        self._val_seeded = False
+
+    def init_chain(self, validators, chain_id: str = "",
+                   app_state=None) -> None:
+        self._validators = {v.pubkey: v.power for v in validators}
+        self._val_seeded = True
 
     def info(self) -> ResultInfo:
         return ResultInfo(data=f"kvstore:{len(self.store)}",
@@ -52,6 +72,20 @@ class KVStoreApp(BaseApplication):
                     raise ValueError(tx)
             except (ValueError, UnicodeDecodeError):
                 return ResultDeliverTx(code=1, log=f"bad val tx {tx!r}")
+            if update.power == 0:
+                if update.pubkey not in self._validators:
+                    return ResultDeliverTx(
+                        code=2, log="cannot remove unknown validator "
+                        f"{pk_hex.decode()[:16]}")
+                # the "would empty the set" check needs the full picture;
+                # an unseeded app (no InitChain) can't distinguish "last
+                # validator" from "last one I happen to know about"
+                if self._val_seeded and len(self._validators) == 1:
+                    return ResultDeliverTx(
+                        code=3, log="validator set would be empty")
+                del self._validators[update.pubkey]
+            else:
+                self._validators[update.pubkey] = update.power
             self._val_updates.append(update)
             self.tx_count += 1
             return ResultDeliverTx(tags={"val": pk_hex.decode()[:16]})

@@ -85,7 +85,11 @@ def _parse_mesh_spec(mesh: str) -> str | int:
 class BatchVerifier:
     def __init__(self, backend: str = "auto", auto_threshold: int = 4,
                  kernel: Callable | None = None, mesh: str = "off"):
-        assert backend in ("auto", "jax", "python")
+        # eager, loud validation — this is fed by config/env text, and a
+        # typo must fail at startup (asserts vanish under python -O)
+        if backend not in ("auto", "jax", "python"):
+            raise ValueError(
+                f"verifier backend must be auto|jax|python, got {backend!r}")
         self.backend = backend
         self.auto_threshold = auto_threshold
         self.kernel = kernel

@@ -30,6 +30,11 @@ _flags.append("--xla_force_host_platform_device_count=8")
 _flags.append("--xla_backend_optimization_level=0")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+# default_verifier()'s mesh="auto" would see the 8 virtual devices and
+# add the 8-way sharded compile (minutes on this 1-core host) to EVERY
+# test that does a batched verify; only the explicit mesh tests should
+# pay that. They construct BatchVerifier(mesh=...) directly.
+os.environ.setdefault("TM_TPU_MESH", "off")
 
 import jax  # noqa: E402  (after env setup, before any backend use)
 

@@ -121,3 +121,48 @@ def test_socket_concurrent_connections(socket_kvstore):
     assert not errs
     assert conns.query.info().last_block_height == 1
     conns.close()
+
+
+def test_kvstore_validator_update_guard():
+    """persistent_dummy's updateValidator guard: removals of unknown
+    validators and set-emptying batches are rejected at DeliverTx so an
+    invalid update never reaches EndBlock (where the core would treat it
+    as a consensus failure and halt)."""
+    from tendermint_tpu.abci.types import ValidatorUpdate
+
+    def val_tx(pk: bytes, power: int) -> bytes:
+        return b"val:" + pk.hex().encode() + b"/%d" % power
+
+    a, b, c = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+    app = KVStoreApp()
+    app.init_chain([ValidatorUpdate(a, 10), ValidatorUpdate(b, 10)], "t")
+
+    # unknown removal -> rejected, nothing queued
+    assert app.deliver_tx(val_tx(c, 0)).code == 2
+    assert app.end_block(1).validator_updates == []
+
+    # legit add + power change + removal all pass
+    assert app.deliver_tx(val_tx(c, 5)).code == 0
+    assert app.deliver_tx(val_tx(a, 30)).code == 0
+    assert app.deliver_tx(val_tx(b, 0)).code == 0
+    ups = app.end_block(1).validator_updates
+    assert [(u.pubkey, u.power) for u in ups] == [(c, 5), (a, 30), (b, 0)]
+
+    # same-block visibility: add X then remove X is coherent
+    x = b"\x04" * 32
+    assert app.deliver_tx(val_tx(x, 7)).code == 0
+    assert app.deliver_tx(val_tx(x, 0)).code == 0
+    app.end_block(2)
+
+    # draining the set to empty is refused on the last member
+    assert app.deliver_tx(val_tx(a, 0)).code == 0
+    assert app.deliver_tx(val_tx(c, 0)).code == 3  # last one standing
+    ups = app.end_block(3).validator_updates
+    assert [(u.pubkey, u.power) for u in ups] == [(a, 0)]
+
+    # an UNSEEDED app (no InitChain) still blocks unknown removals but
+    # cannot judge emptiness -> allows removing the last tx-added one
+    app2 = KVStoreApp()
+    assert app2.deliver_tx(val_tx(a, 0)).code == 2
+    assert app2.deliver_tx(val_tx(a, 9)).code == 0
+    assert app2.deliver_tx(val_tx(a, 0)).code == 0
