@@ -72,18 +72,24 @@ class KVStoreApp(BaseApplication):
                     raise ValueError(tx)
             except (ValueError, UnicodeDecodeError):
                 return ResultDeliverTx(code=1, log=f"bad val tx {tx!r}")
+            # fault injection (reference fail-point spirit, utils/fail.py):
+            # tests set TM_KVSTORE_UNSAFE_VAL_UPDATES to bypass the guard
+            # and drive the core's ApplyBlockError/halt path end-to-end
+            import os as _os
+            guard = not _os.environ.get("TM_KVSTORE_UNSAFE_VAL_UPDATES")
             if update.power == 0:
-                if update.pubkey not in self._validators:
+                if guard and update.pubkey not in self._validators:
                     return ResultDeliverTx(
                         code=2, log="cannot remove unknown validator "
                         f"{pk_hex.decode()[:16]}")
                 # the "would empty the set" check needs the full picture;
                 # an unseeded app (no InitChain) can't distinguish "last
                 # validator" from "last one I happen to know about"
-                if self._val_seeded and len(self._validators) == 1:
+                if guard and self._val_seeded and \
+                        len(self._validators) == 1:
                     return ResultDeliverTx(
                         code=3, log="validator set would be empty")
-                del self._validators[update.pubkey]
+                self._validators.pop(update.pubkey, None)
             else:
                 self._validators[update.pubkey] = update.power
             self._val_updates.append(update)

@@ -104,6 +104,14 @@ class ConsensusReactor(Reactor):
         self._peer_threads: Dict[str, list] = {}
         self._lock = threading.Lock()
         self._stopped = False
+        # verified heartbeats already published, keyed (validator, height,
+        # round, sequence); cleared on height change, hard-capped. Bounds
+        # replay spam: each distinct valid heartbeat verifies + publishes
+        # at most once. _hb_lock is held across check->verify->publish so
+        # two peers delivering the same heartbeat can't double-publish.
+        self._hb_seen: set = set()
+        self._hb_seen_height = 0
+        self._hb_lock = threading.Lock()
 
     def get_channels(self):
         return [
@@ -215,18 +223,44 @@ class ConsensusReactor(Reactor):
                     hb = Heartbeat.from_obj(msg["heartbeat"])
                 except (KeyError, ValueError, TypeError):
                     return  # malformed: drop
-                idx, val = self.cs.rs.validators.get_by_address(
-                    hb.validator_address)
-                if val is None or idx != hb.validator_index:
-                    return  # not a current validator: drop
-                from tendermint_tpu.types.keys import PubKey
-                if not PubKey(val.pubkey).verify(
-                        hb.sign_bytes(self.cs.state.chain_id),
-                        hb.signature):
-                    return  # forged: drop
-                self.cs.event_bus.publish(
-                    "ProposalHeartbeat", {"heartbeat": hb.to_obj(),
-                                          "peer": peer.id})
+                rs = self.cs.rs
+                # freshness BEFORE the (ms-scale) signature check: a
+                # replayed validly-signed old heartbeat must not
+                # re-verify in a loop on the peer receive thread. The
+                # round/sequence windows also bound the dedup-set keys
+                # an attacker (even a current validator) can mint.
+                if hb.height != rs.height or \
+                        not rs.round <= hb.round <= rs.round + 1 or \
+                        not 0 <= hb.sequence < 4096:
+                    return  # stale/future/implausible: drop
+                hb_key = (hb.validator_address, hb.height, hb.round,
+                          hb.sequence)
+                # one critical section across check->verify->publish:
+                # two peers delivering the same heartbeat concurrently
+                # must not both verify + publish. Serializing heartbeat
+                # verification is fine — it's a low-rate liveness signal.
+                with self._hb_lock:
+                    if self._hb_seen_height != hb.height or \
+                            len(self._hb_seen) > 8192:
+                        self._hb_seen.clear()
+                        self._hb_seen_height = hb.height
+                    if hb_key in self._hb_seen:
+                        return  # already verified + published once
+                    idx, val = rs.validators.get_by_address(
+                        hb.validator_address)
+                    if val is None or idx != hb.validator_index:
+                        return  # not a current validator: drop
+                    from tendermint_tpu.types.keys import PubKey
+                    if not PubKey(val.pubkey).verify(
+                            hb.sign_bytes(self.cs.state.chain_id),
+                            hb.signature):
+                        return  # forged: drop
+                    # record only VERIFIED heartbeats so a forgery can't
+                    # squat the key and block the real one
+                    self._hb_seen.add(hb_key)
+                    self.cs.event_bus.publish(
+                        "ProposalHeartbeat", {"heartbeat": hb.to_obj(),
+                                              "peer": peer.id})
             elif t == "vote_set_maj23":
                 # peer claims +2/3 for a block: record + reply with our bits
                 if self.fast_sync:

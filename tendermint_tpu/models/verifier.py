@@ -84,7 +84,8 @@ def _parse_mesh_spec(mesh: str) -> str | int:
 
 class BatchVerifier:
     def __init__(self, backend: str = "auto", auto_threshold: int = 4,
-                 kernel: Callable | None = None, mesh: str = "off"):
+                 kernel: Callable | None = None, mesh: str = "off",
+                 min_bucket: int = 8):
         # eager, loud validation — this is fed by config/env text, and a
         # typo must fail at startup (asserts vanish under python -O)
         if backend not in ("auto", "jax", "python"):
@@ -95,7 +96,10 @@ class BatchVerifier:
         self.kernel = kernel
         self.mesh = _parse_mesh_spec(mesh)
         self.mesh_devices = 0          # >0 once a sharded kernel is active
-        self._min_bucket = 8
+        # callers injecting a sharded kernel= must set min_bucket to a
+        # multiple of their mesh size so padded batches stay divisible
+        # (the mesh= knob derives this itself in _resolve_mesh)
+        self._min_bucket = min_bucket
         self._mesh_resolved = kernel is not None or self.mesh == "off"
         self._resolve_lock = threading.Lock()
         self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}

@@ -165,7 +165,15 @@ class RPCCore:
         peer_states = {}
         if self.env.switch is not None:
             reactor = self.env.switch.reactors.get("consensus")
-            for pid, ps in getattr(reactor, "peer_states", {}).items():
+            # snapshot under the reactor's lock: add_peer/remove_peer
+            # mutate the dict from peer threads while this RPC iterates
+            lock = getattr(reactor, "_lock", None)
+            if lock is not None:
+                with lock:
+                    items = list(reactor.peer_states.items())
+            else:
+                items = list(getattr(reactor, "peer_states", {}).items())
+            for pid, ps in items:
                 (h, r, step, has_prop, parts,
                  last_commit_round) = ps.snapshot()
                 peer_states[pid] = {

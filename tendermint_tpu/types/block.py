@@ -191,11 +191,35 @@ class Commit:
             if v.height != h or v.round != r:
                 raise ValueError("commit votes differ in height/round")
 
+    def __setattr__(self, name, value):
+        # same contract as Header: ANY field write drops the cached
+        # hash/obj, so a mutated commit can never serve stale bytes
+        if not name.startswith("_"):
+            self.__dict__.pop("_hash", None)
+            self.__dict__.pop("_obj", None)
+            self.__dict__.pop("_fp", None)
+        object.__setattr__(self, name, value)
+
+    def _check_cache_fresh(self) -> None:
+        # __setattr__ can't see IN-PLACE mutation (precommits[i].signature
+        # = ..., the tamper-test idiom), so the caches are additionally
+        # keyed on a fingerprint of every sign-relevant vote field plus
+        # the commit's own block id — tuple compares over small values,
+        # far cheaper than the O(V) canonical encodes they guard
+        fp = (self.block_id.key(),
+              tuple((v.signature, v.timestamp_ns, v.height, v.round,
+                     int(v.type), v.block_id.key()) if v is not None
+                    else None for v in self.precommits))
+        if self.__dict__.get("_fp") != fp:
+            self.__dict__.pop("_hash", None)
+            self.__dict__.pop("_obj", None)
+            self.__dict__["_fp"] = fp
+
     def hash(self) -> bytes:
-        # cached: a commit is built complete and never mutated (VoteSet
-        # .make_commit / from_obj construct fresh instances), and the
-        # sync loop hashes the same commit for validate_basic + header
-        # checks + store meta — O(V) encodes each time at V validators
+        # cached: the sync loop hashes the same commit for validate_basic
+        # + header checks + store meta — O(V) encodes each time at V
+        # validators; invalidation via __setattr__ + _check_cache_fresh
+        self._check_cache_fresh()
         if "_hash" not in self.__dict__:
             leaves = [encoding.cdumps(v.to_obj() if v else None)
                       for v in self.precommits]
@@ -203,6 +227,7 @@ class Commit:
         return self.__dict__["_hash"]
 
     def to_obj(self):
+        self._check_cache_fresh()
         if "_obj" not in self.__dict__:
             self.__dict__["_obj"] = {
                 "block_id": self.block_id.to_obj(),
@@ -294,7 +319,8 @@ class Block:
         # header changing was already an inconsistent block before any
         # caching: the header's derived hashes would be stale.)
         hh = self.header.hash()
-        if self.__dict__.get("_bytes_hh") == hh and                 self.__dict__.get("_bytes") is not None:
+        if (self.__dict__.get("_bytes_hh") == hh
+                and self.__dict__.get("_bytes") is not None):
             return self.__dict__["_bytes"]
         b = encoding.cdumps(self.to_obj())
         self.__dict__["_bytes"] = b

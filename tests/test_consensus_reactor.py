@@ -193,3 +193,99 @@ def test_heartbeat_receive_verifies_signature():
     reactor.receive(0x20, peer, encoding.cdumps(
         {"type": "heartbeat", "heartbeat": outsider.to_obj()}))
     assert not got(), "forged/non-validator heartbeats must drop"
+
+
+def test_heartbeat_replay_deduped_and_stale_dropped():
+    """A validly-signed heartbeat publishes ONCE: replays are dropped at
+    the dedup set before re-verifying (a replay loop must not burn the
+    receive thread on ms-scale sig checks), and heartbeats for another
+    height / an already-passed round never reach verification."""
+    from tendermint_tpu.types import encoding
+    from tendermint_tpu.types.events import EventBus
+    from tendermint_tpu.types.proposal import Heartbeat
+
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(2)]
+    gen = GenesisDoc(chain_id="hb-replay", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    cs = make_validator_node(gen, keys[0])
+    bus = EventBus()
+    cs.event_bus = bus
+    reactor = ConsensusReactor(cs)
+    sub = bus.subscribe("hb-replay", "tm.event='ProposalHeartbeat'")
+
+    def drain():
+        out = []
+        while not sub.queue.empty():
+            out.append(sub.queue.get_nowait())
+        return out
+
+    class FakePeer:
+        id = "fakepeer"
+        running = True
+        def set(self, k, v): pass
+        def try_send_obj(self, ch, obj): return True
+
+    peer = FakePeer()
+    reactor.peer_states[peer.id] = __import__(
+        "tendermint_tpu.consensus.reactor",
+        fromlist=["PeerRoundState"]).PeerRoundState()
+
+    verifies = 0
+    import tendermint_tpu.types.keys as keys_mod
+    orig_verify = keys_mod.PubKey.verify
+    def counting_verify(self, *a, **k):
+        nonlocal verifies
+        verifies += 1
+        return orig_verify(self, *a, **k)
+    keys_mod.PubKey.verify = counting_verify
+    try:
+        idx, _ = cs.rs.validators.get_by_address(keys[1].pubkey.address)
+        hb = Heartbeat(keys[1].pubkey.address, idx, cs.rs.height, 0, 3)
+        hb.signature = keys[1].sign(hb.sign_bytes("hb-replay"))
+        wire = encoding.cdumps({"type": "heartbeat",
+                                "heartbeat": hb.to_obj()})
+        for _ in range(5):          # replay loop
+            reactor.receive(0x20, peer, wire)
+        assert len(drain()) == 1, "replayed heartbeat must publish once"
+        assert verifies == 1, f"replays re-verified {verifies} times"
+
+        # wrong height / stale round: dropped BEFORE verification
+        stale = Heartbeat(keys[1].pubkey.address, idx,
+                          cs.rs.height + 7, 0, 0)
+        stale.signature = keys[1].sign(stale.sign_bytes("hb-replay"))
+        reactor.receive(0x20, peer, encoding.cdumps(
+            {"type": "heartbeat", "heartbeat": stale.to_obj()}))
+        assert verifies == 1 and not drain()
+    finally:
+        keys_mod.PubKey.verify = orig_verify
+
+
+def test_commit_cache_invalidates_on_mutation():
+    """Commit.hash()/to_obj() caches must never serve stale bytes after
+    the commit is mutated — whole-field writes AND in-place precommit
+    tampering (the evidence/tamper idiom) both invalidate."""
+    from tendermint_tpu.types.block import BlockID, Commit, PartSetHeader
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    key = PrivKey.generate(b"\x01" * 32)
+    bid = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+    votes = []
+    for i in range(3):
+        v = Vote(validator_address=key.pubkey.address, validator_index=i,
+                 height=5, round=0, type=VoteType.PRECOMMIT, block_id=bid,
+                 timestamp_ns=1000 + i)
+        v.signature = key.sign(v.sign_bytes("c"))
+        votes.append(v)
+    commit = Commit(block_id=bid, precommits=list(votes))
+
+    h0 = commit.hash()
+    o0 = commit.to_obj()
+    # in-place tamper: __setattr__ never fires, fingerprint must catch it
+    commit.precommits[1].signature = bytes(64)
+    assert commit.hash() != h0
+    assert commit.to_obj() != o0
+    # field write invalidates too
+    h1 = commit.hash()
+    commit.precommits = commit.precommits[:2]
+    assert commit.hash() != h1
