@@ -5,7 +5,7 @@ StaticCertifier   — fixed valset, certify one height
 DynamicCertifier  — follows valset changes via verify_commit_any
                     (lite/dynamic_certifier.go:20,70)
 InquiringCertifier— auto-updates through a Provider with BISECTION over
-                    heights when the valset moved more than +1/3 at once
+                    heights when the valset moved too far at once
                     (lite/inquiring_certifier.go:15,67,137-163)
 
 certify_chain     — the TPU batch path: certify a whole run of
@@ -51,7 +51,8 @@ class StaticCertifier:
 
 class DynamicCertifier:
     """Static + `update`: accept a new valset when +2/3 of it signed AND
-    +1/3 of the currently-trusted set signed (verify_commit_any)."""
+    +2/3 of the currently-trusted set signed (verify_commit_any, the
+    v0.16 rule — types/validator_set.go:345-347)."""
 
     def __init__(self, chain_id: str, validators: ValidatorSet,
                  height: int = 0, verifier=None):

@@ -204,8 +204,25 @@ class ValidatorSet:
 
     def verify_commit_any(self, new_set: "ValidatorSet", chain_id: str,
                           block_id, height: int, commit, verifier=None) -> None:
-        """Lite-client transition check (types/validator_set.go:288): +2/3 of
-        the NEW set signed, and +1/3 of THIS (old, trusted) set signed."""
+        """Lite-client valset-transition check — reference parity with
+        types/validator_set.go:288-353 VerifyCommitAny, including its
+        STRICT >2/3 OLD-set threshold (:345-347; round 2 shipped a 1/3
+        rule, the later-Tendermint light-client model — v0.16 is
+        stricter, and this build pins the v0.16 rule with tests):
+
+        - only votes for `block_id` count (:319, not an error otherwise)
+        - each counted vote is verified against THIS (old, trusted)
+          set's pubkey, looked up by the vote's validator address;
+          validators unknown to the old set are SKIPPED entirely —
+          never verified, never counted (:322-327)
+        - duplicate addresses count once (:327 `seen`)
+        - new-set power accrues only where the new validator at that
+          commit index carries the SAME pubkey (:337-341)
+        - accept iff old_power > 2/3 of the old total AND
+          new_power > 2/3 of the new total (:345-350)
+
+        Signatures still go through the verifier as ONE batch.
+        Raises ValueError on failure."""
         from tendermint_tpu.models.verifier import default_verifier
         verifier = verifier or default_verifier()
         if len(new_set.validators) != commit.size():
@@ -214,30 +231,40 @@ class ValidatorSet:
             raise ValueError("commit height mismatch")
 
         items = []
-        meta = []  # (new_power, old_power, for_block)
+        meta = []  # (old_power, new_power_if_same_pubkey)
+        seen = set()
         round_ = commit.round()
         for idx, pc in enumerate(commit.precommits):
             if pc is None:
                 continue
-            if pc.type != VoteType.PRECOMMIT or pc.height != height or pc.round != round_:
+            if pc.type != VoteType.PRECOMMIT or pc.height != height \
+                    or pc.round != round_:
                 raise ValueError("bad commit vote")
+            if pc.block_id != block_id:
+                continue  # not an error, but doesn't count
+            oi, ov = self.get_by_address(pc.validator_address)
+            if ov is None or oi in seen:
+                continue  # unknown to the trusted set, or double vote
+            seen.add(oi)
             nv = new_set.validators[idx]
-            oi, ov = self.get_by_address(nv.address)
-            items.append((nv.pubkey, pc.sign_bytes(chain_id), pc.signature))
-            meta.append((nv.voting_power, ov.voting_power if oi >= 0 else 0,
-                         pc.block_id == block_id))
+            items.append((ov.pubkey, pc.sign_bytes(chain_id), pc.signature))
+            meta.append((ov.voting_power,
+                         nv.voting_power if nv.pubkey == ov.pubkey else 0))
         ok = verifier.verify(items)
-        new_power = old_power = 0
-        for valid, (npow, opow, for_block) in zip(ok, meta):
+        old_power = new_power = 0
+        for valid, (opow, npow) in zip(ok, meta):
             if not valid:
                 raise ValueError("invalid signature in commit")
-            if for_block:
-                new_power += npow
-                old_power += opow
+            old_power += opow
+            new_power += npow
+        if not old_power * 3 > self.total_voting_power() * 2:
+            raise ValueError(
+                f"insufficient old-set (trusted) voting power: got "
+                f"{old_power}, need > {self.total_voting_power() * 2 / 3:g}")
         if not new_power * 3 > new_set.total_voting_power() * 2:
-            raise ValueError("insufficient new-set voting power")
-        if not old_power * 3 > self.total_voting_power():
-            raise ValueError("insufficient old-set (trusted) voting power")
+            raise ValueError(
+                f"insufficient new-set voting power: got {new_power}, "
+                f"need > {new_set.total_voting_power() * 2 / 3:g}")
 
     # -- updates -------------------------------------------------------------
 

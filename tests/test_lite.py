@@ -121,11 +121,12 @@ def test_inquiring_certifier_bisects():
     vk2.keys = vk1.keys[:3] + [PrivKey.generate(b"\x70" * 32)]
     vk2.valset = ValidatorSet(
         [Validator(k.pubkey.ed25519, 10) for k in vk2.keys])
-    # vk3 keeps K0 + vk2's new key: 2/4 overlap with vk2 (> 1/3) but only
-    # 1/4 with vk1 (< 1/3) -> direct update from height 1 must fail
+    # vk3 rotates ONE MORE key: 3/4 overlap with vk2 (> 2/3, bridgeable
+    # under the v0.16 VerifyCommitAny rule) but only 2/4 with vk1
+    # (<= 2/3) -> direct update from height 1 must fail
     vk3 = type("VK", (ValKeysView,), {})(vk2)
-    vk3.keys = [vk2.keys[0], vk2.keys[3]] + \
-        [PrivKey.generate(bytes([0x71 + i]) * 32) for i in range(2)]
+    vk3.keys = vk2.keys[:2] + \
+        [vk2.keys[3], PrivKey.generate(b"\x71" * 32)]
     vk3.valset = ValidatorSet(
         [Validator(k.pubkey.ed25519, 10) for k in vk3.keys])
 
@@ -135,7 +136,7 @@ def test_inquiring_certifier_bisects():
 
     trusted = vk1.sign_header(1)
     cert = InquiringCertifier(CHAIN, trusted, provider)
-    # direct update 1 -> 25 fails (vk3 overlaps vk1 by only 1/4 power);
+    # direct update 1 -> 25 fails (vk3 overlaps vk1 by only 2/4 power);
     # bisection finds height 10 (vk2: 3/4 overlap), then 20, then 25
     cert.certify(vk3.sign_header(25))
     assert cert.last_height >= 20

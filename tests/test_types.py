@@ -419,3 +419,97 @@ def test_vote_sign_bytes_fast_path():
                      height=7, round=2, timestamp_ns=123456789,
                      type=VoteType.PRECOMMIT, block_id=bid)
             assert v.sign_bytes(cid) == encoding.cdumps(v.sign_obj(cid))
+
+
+# -------------------------------------------------- verify_commit_any -------
+# Pins the v0.16 VerifyCommitAny semantics (types/validator_set.go:288-353):
+# STRICT >2/3 of the OLD (trusted) set — round 2 shipped a 1/3 rule (the
+# later-Tendermint light-client model); v0.16 is stricter and these tests
+# pin the chosen rule at its exact boundaries.
+
+def _valset_powers(seed_powers):
+    """[(seed_byte, power)] -> (ValidatorSet, {address: priv})."""
+    privs, vals = {}, []
+    for sb, pw in seed_powers:
+        p = PrivKey.generate(bytes([sb]) * 32)
+        privs[p.pubkey.address] = p
+        vals.append(Validator(p.pubkey.ed25519, pw))
+    return ValidatorSet(vals), privs
+
+
+def _commit_for(new_vs, privs, height, bid, garbage=()):
+    """Commit indexed by new_vs order; addresses in `garbage` get a
+    syntactically-valid but forged signature."""
+    pcs = []
+    for idx, val in enumerate(new_vs.validators):
+        p = privs.get(val.address)
+        if p is None:
+            pcs.append(None)
+            continue
+        v = signed_vote(p, idx, height, 0, VoteType.PRECOMMIT, bid)
+        if val.address in garbage:
+            v.signature = bytes(64)
+        pcs.append(v)
+    return Commit(block_id=bid, precommits=pcs)
+
+
+def test_verify_commit_any_full_overlap_accepts():
+    old, privs = _valset_powers([(1, 10), (2, 10), (3, 10)])
+    bid = make_block_id()
+    commit = _commit_for(old, privs, 7, bid)
+    old.verify_commit_any(old, CHAIN, bid, 7, commit, verifier=PYV)
+
+
+def test_verify_commit_any_exactly_two_thirds_old_rejected():
+    # old total 30; overlap signs exactly 20 = 2/3 -> REJECT (strict >)
+    old, privs = _valset_powers([(1, 10), (2, 10), (3, 10)])
+    new, nprivs = _valset_powers([(1, 10), (2, 10)])
+    privs.update(nprivs)
+    bid = make_block_id()
+    commit = _commit_for(new, privs, 7, bid)
+    with pytest.raises(ValueError, match="insufficient old"):
+        old.verify_commit_any(new, CHAIN, bid, 7, commit, verifier=PYV)
+
+
+def test_verify_commit_any_just_above_two_thirds_accepts():
+    # old total 30; overlap signs 21 > 2/3 -> accept
+    old, privs = _valset_powers([(1, 11), (2, 10), (3, 9)])
+    new, nprivs = _valset_powers([(1, 11), (2, 10)])
+    privs.update(nprivs)
+    bid = make_block_id()
+    commit = _commit_for(new, privs, 7, bid)
+    old.verify_commit_any(new, CHAIN, bid, 7, commit, verifier=PYV)
+
+
+def test_verify_commit_any_middle_overlap_rejected():
+    # overlap 15/30: above 1/3 (round-2 rule would ACCEPT), below 2/3 ->
+    # v0.16 rejects. This is the divergence-closing pin.
+    old, privs = _valset_powers([(1, 15), (2, 8), (3, 7)])
+    new, nprivs = _valset_powers([(1, 15), (9, 5)])
+    privs.update(nprivs)
+    bid = make_block_id()
+    commit = _commit_for(new, privs, 7, bid)
+    with pytest.raises(ValueError, match="insufficient old"):
+        old.verify_commit_any(new, CHAIN, bid, 7, commit, verifier=PYV)
+
+
+def test_verify_commit_any_unknown_validator_never_verified():
+    # a validator unknown to the trusted set is SKIPPED (:322-327): its
+    # garbage signature must not fail the commit, and it contributes no
+    # power to either side
+    old, privs = _valset_powers([(1, 11), (2, 10), (3, 9)])
+    new, nprivs = _valset_powers([(1, 11), (2, 10), (9, 2)])
+    privs.update(nprivs)
+    bid = make_block_id()
+    ghost_addr = PrivKey.generate(bytes([9]) * 32).pubkey.address
+    commit = _commit_for(new, privs, 7, bid, garbage={ghost_addr})
+    old.verify_commit_any(new, CHAIN, bid, 7, commit, verifier=PYV)
+
+
+def test_verify_commit_any_invalid_overlap_signature_fails():
+    old, privs = _valset_powers([(1, 10), (2, 10), (3, 10)])
+    bid = make_block_id()
+    bad_addr = old.validators[0].address
+    commit = _commit_for(old, privs, 7, bid, garbage={bad_addr})
+    with pytest.raises(ValueError, match="invalid signature"):
+        old.verify_commit_any(old, CHAIN, bid, 7, commit, verifier=PYV)
