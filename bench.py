@@ -113,7 +113,7 @@ def main() -> int:
         try:
             import bench_fastsync
             extra["fastsync"] = bench_fastsync.run(
-                256, 64, 8, scalar_baseline=True)
+                5120, 64, 32, scalar_baseline=True)
         except Exception as e:  # pragma: no cover
             extra["fastsync_error"] = repr(e)
         try:

@@ -65,6 +65,13 @@ class ScalarVerifier:
     def verify_one(self, pub, msg, sig) -> bool:
         return self._verify(pub, msg, sig)
 
+    def verify_async(self, items):
+        """Scalar work has no async dimension: verify now, hand back the
+        result thunk (keeps the reactor's pipelined loop verifier-shape
+        agnostic)."""
+        out = self.verify(items)
+        return lambda: out
+
 
 def enable_tpu_compilation_cache(jax_module=None) -> None:
     """Point JAX at the repo-local .jax_cache — TPU backends ONLY.

@@ -1,9 +1,17 @@
 """KVStore app — the reference's "dummy" app, upgraded with a Merkle state.
 
-Txs are "key=value" (or opaque bytes stored under themselves). The app hash
-is the Merkle root (ops/merkle) over sorted key=value leaves, so every
-committed height has a verifiable state commitment — what the reference's
-dummy app gets from its IAVL tree.
+Txs are "key=value" (or opaque bytes stored under themselves). The app
+hash is a Merkle root (ops/merkle) over N_BUCKETS bucket digests; a
+bucket digest commits to an additive accumulator (sum of its keys'
+pair digests mod 2^256, plus the key count), so a key change is O(1)
+and a commit is O(changed keys + dirty buckets) — state-size
+independent, where a naive rebuild is O(total state) per block and
+comes to dominate long syncs. The reference's dummy gets
+incrementality from its IAVL tree; the hash value itself is
+app-defined in both builds. (Additive set-hashing trades collision
+margin for O(1) updates — the known generalized-birthday attacks need
+~2^80+ work per bucket, acceptable for this demo app; swap in an IAVL
+module if an application needs stronger commitments or range proofs.)
 
 Validator-change txs (the reference's persistent_dummy surface):
 `val:<pubkey_hex>/<power>` queues a validator update returned from
@@ -22,12 +30,22 @@ unknown pubkey with power 0 would halt the whole network.
 
 from __future__ import annotations
 
+import hashlib
+import zlib
+
 from tendermint_tpu.abci.app import BaseApplication
 from tendermint_tpu.abci.types import (
     ResultCheckTx, ResultDeliverTx, ResultEndBlock, ResultInfo,
     ResultQuery, ValidatorUpdate,
 )
 from tendermint_tpu.ops import merkle
+
+N_BUCKETS = 256   # app-hash buckets; must be a power of two. Tradeoff:
+#                   bucket re-hash cost grows with state/N_BUCKETS, the
+#                   per-commit root costs N_BUCKETS-1 node hashes — 256
+#                   balances both for ~10^4-10^6 keys
+# digest of an empty bucket (leaf hash of no pairs)
+_EMPTY_BUCKET = hashlib.sha256(b"\x00").digest()
 
 
 class KVStoreApp(BaseApplication):
@@ -36,6 +54,17 @@ class KVStoreApp(BaseApplication):
         self.height = 0
         self.app_hash = b""
         self.tx_count = 0
+        # incremental app-hash state (see commit()): keys spread over
+        # fixed buckets; each bucket holds an ADDITIVE accumulator (sum
+        # of pair digests mod 2^256) so a key change is O(1) regardless
+        # of state size
+        self._bucket_acc: list[int] = [0] * N_BUCKETS
+        self._bucket_count: list[int] = [0] * N_BUCKETS
+        # flat digest buffer (bucket b at [32b:32b+32]) — handed to the
+        # native merkle kernel without join/copy
+        self._bucket_digest = bytearray(_EMPTY_BUCKET * N_BUCKETS)
+        self._pair_digest: dict[bytes, bytes] = {}
+        self._dirty: set[bytes] = set()
         self._val_updates: list[ValidatorUpdate] = []
         # pubkey -> power of the ACTIVE set, as the app knows it: seeded
         # by init_chain, advanced immediately by its own accepted updates
@@ -100,13 +129,56 @@ class KVStoreApp(BaseApplication):
         else:
             k = v = tx
         self.store[k] = v
+        self._dirty.add(k)
         self.tx_count += 1
         return ResultDeliverTx(tags={"app.key": k.decode("utf-8", "replace")})
 
     def commit(self) -> bytes:
+        # App hash = Merkle root over N_BUCKETS bucket digests; a bucket
+        # digest commits to its additive accumulator (sum of pair
+        # digests mod 2^256) + key count. O(changed keys) per commit,
+        # state-size independent — see the module docstring for the
+        # construction and its tradeoff.
         self.height += 1
-        leaves = [k + b"=" + v for k, v in sorted(self.store.items())]
-        self.app_hash = merkle.root_host(leaves) if leaves else b"\x00" * 32
+        if self._dirty:
+            sha = hashlib.sha256
+            pd = self._pair_digest
+            acc, cnt = self._bucket_acc, self._bucket_count
+            dirty_buckets = set()
+            for k in self._dirty:
+                b = zlib.crc32(k) & (N_BUCKETS - 1)
+                dirty_buckets.add(b)
+                old = pd.get(k)
+                v = self.store.get(k)
+                if v is None:
+                    if old is not None:
+                        del pd[k]
+                        acc[b] -= int.from_bytes(old, "little")
+                        cnt[b] -= 1
+                else:
+                    # pair digest: sha(len k|k|len v|v) — cached per key
+                    d = sha(len(k).to_bytes(4, "little") + k
+                            + len(v).to_bytes(4, "little") + v).digest()
+                    if old is not None:
+                        acc[b] -= int.from_bytes(old, "little")
+                    else:
+                        cnt[b] += 1
+                    acc[b] += int.from_bytes(d, "little")
+                    pd[k] = d
+            self._dirty.clear()
+            for b in dirty_buckets:
+                if cnt[b] == 0:
+                    d = _EMPTY_BUCKET
+                else:
+                    d = sha(b"\x00"
+                            + (acc[b] % (1 << 256)).to_bytes(32, "little")
+                            + cnt[b].to_bytes(8, "little")).digest()
+                self._bucket_digest[32 * b:32 * b + 32] = d
+        if not self.store:
+            self.app_hash = b"\x00" * 32
+        else:
+            self.app_hash = merkle.root_from_digests_host(
+                self._bucket_digest)
         return self.app_hash
 
     def end_block(self, height: int) -> ResultEndBlock:

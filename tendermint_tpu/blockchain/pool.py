@@ -71,14 +71,19 @@ class _Request:
 class BlockPool:
     def __init__(self, start_height: int,
                  send_request: Callable[[str, int], bool],
-                 on_peer_error: Callable[[str, str], None]):
+                 on_peer_error: Callable[[str, str], None],
+                 max_pending_per_peer: int = MAX_PENDING_PER_PEER):
         """send_request(peer_id, height) -> sent ok;
-        on_peer_error(peer_id, reason) drops the peer at the switch."""
+        on_peer_error(peer_id, reason) drops the peer at the switch.
+        max_pending_per_peer: in-flight request cap per peer — the
+        reference default (pool.go), raised by benches whose single
+        in-process peer would otherwise cap the verify window."""
         from tendermint_tpu.utils.log import get_logger
         self.logger = get_logger("blockchain")
         self.height = start_height           # next height to sync
         self.send_request = send_request
         self.on_peer_error = on_peer_error
+        self.max_pending_per_peer = max_pending_per_peer
         self._lock = threading.Lock()
         self.peers: Dict[str, BpPeer] = {}
         self.requests: Dict[int, _Request] = {}
@@ -152,7 +157,7 @@ class BlockPool:
     def _pick_peer(self, height: int) -> Optional[BpPeer]:
         candidates = [p for p in self.peers.values()
                       if p.height >= height and
-                      p.num_pending < MAX_PENDING_PER_PEER]
+                      p.num_pending < self.max_pending_per_peer]
         if not candidates:
             return None
         return min(candidates, key=lambda p: p.num_pending)
@@ -208,14 +213,16 @@ class BlockPool:
             return (first.block if first else None,
                     second.block if second else None)
 
-    def peek_window(self, k: int) -> List:
-        """Up to k+1 consecutive completed blocks starting at `height`.
-        The reactor verifies block i with block i+1's LastCommit, so a
-        returned list of n blocks yields n-1 verifiable ones. Feeds the
-        batched commit verification in the reactor."""
+    def peek_window(self, k: int, skip: int = 0) -> List:
+        """Up to k+1 consecutive completed blocks starting at
+        `height + skip`. The reactor verifies block i with block i+1's
+        LastCommit, so a returned list of n blocks yields n-1 verifiable
+        ones. `skip` lets the reactor collect the NEXT window while a
+        previous window's device dispatch is still in flight (the
+        pipelined sync loop)."""
         with self._lock:
             blocks = []
-            h = self.height
+            h = self.height + skip
             while len(blocks) < k + 1:
                 req = self.requests.get(h)
                 if req is None or req.block is None:

@@ -139,16 +139,28 @@ class BatchVerifier:
 
     def verify(self, items: Sequence[tuple[bytes, bytes, bytes]]) -> np.ndarray:
         """items: (pubkey32, message, signature64) triples -> bool[N]."""
+        return self.verify_async(items)()
+
+    def verify_async(self, items: Sequence[tuple[bytes, bytes, bytes]]):
+        """Dispatch without blocking: returns a zero-arg resolver that
+        materializes bool[N]. jax dispatch is asynchronous, so the
+        caller can overlap device compute with host work (the pipelined
+        fast-sync loop applies window k-1 while window k verifies
+        on-device); every chunk is enqueued up front so the tunnel
+        round-trip is paid once."""
         n = len(items)
         self.stats["calls"] += 1
         self.stats["sigs"] += n
         if n == 0:
-            return np.zeros(0, np.bool_)
+            out0 = np.zeros(0, np.bool_)
+            return lambda: out0
         use_jax = self.backend == "jax" or (
             self.backend == "auto" and n > self.auto_threshold)
         if not use_jax:
             from tendermint_tpu.utils import ed25519_ref as ref
-            return np.array([ref.verify(p, m, s) for p, m, s in items], np.bool_)
+            out1 = np.array([ref.verify(p, m, s) for p, m, s in items],
+                            np.bool_)
+            return lambda: out1
         from tendermint_tpu.ops import ed25519
         if not self._mesh_resolved:
             self._resolve_mesh()
@@ -156,10 +168,6 @@ class BatchVerifier:
         pubkeys = [it[0] for it in items]
         msgs = [it[1] for it in items]
         sigs = [it[2] for it in items]
-        # enqueue every chunk before materializing any result: jax
-        # dispatch is async, so chunk k's device compute overlaps chunk
-        # k+1's host SHA-512 prep and transfer, and the tunnel round-trip
-        # latency is paid once, not per chunk
         pending = []
         for lo in range(0, n, BATCH_CHUNK):
             hi = min(lo + BATCH_CHUNK, n)
@@ -167,10 +175,14 @@ class BatchVerifier:
                 pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel,
                 min_bucket=self._min_bucket)
             pending.append((lo, hi, res, pre))
-        out = np.zeros(n, np.bool_)
-        for lo, hi, res, pre in pending:
-            out[lo:hi] = np.asarray(res)[:hi - lo] & pre
-        return out
+
+        def resolve() -> np.ndarray:
+            out = np.zeros(n, np.bool_)
+            for lo, hi, res, pre in pending:
+                out[lo:hi] = np.asarray(res)[:hi - lo] & pre
+            return out
+
+        return resolve
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         return bool(self.verify([(pubkey, msg, sig)])[0])

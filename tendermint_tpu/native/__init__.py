@@ -74,15 +74,85 @@ def available() -> bool:
     return _load() is not None
 
 
+# -- canonical-JSON codec extension (codec.cpp) -----------------------------
+# A true CPython extension (not ctypes): the encoder walks Python object
+# graphs, which a C ABI can't. Built with the same g++ the hostops use,
+# against the running interpreter's headers.
+
+_CODEC_SRC = os.path.join(_HERE, "codec.cpp")
+_CODEC_LIB = os.path.join(_HERE, "_tmcodec.so")
+_codec_mod = None
+_codec_tried = False
+
+
+def _build_codec() -> Optional[str]:
+    try:
+        if os.path.exists(_CODEC_LIB) and \
+                os.path.getmtime(_CODEC_LIB) >= os.path.getmtime(_CODEC_SRC):
+            return _CODEC_LIB
+    except OSError:
+        # stale .so next to a missing source: use the built lib rather
+        # than crash — every failure here must fall back, never raise
+        return _CODEC_LIB if os.path.exists(_CODEC_LIB) else None
+    import sysconfig
+    inc = sysconfig.get_paths().get("include")
+    if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+        return None
+    tmp = _CODEC_LIB + f".{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{inc}", _CODEC_SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(tmp, _CODEC_LIB)
+    return _CODEC_LIB
+
+
+def codec():
+    """The _tmcodec extension module, or None when unavailable.
+    Exposes canonical_dumps(obj)->bytes and the Fallback exception."""
+    global _codec_mod, _codec_tried
+    with _lock:
+        if _codec_tried:
+            return _codec_mod
+        _codec_tried = True
+        if os.environ.get("TM_TPU_NO_NATIVE"):
+            return None
+        path = _build_codec()
+        if path is None:
+            return None
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location("_tmcodec", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception:
+            return None
+        _codec_mod = mod
+        return _codec_mod
+
+
 def _pack(items: List[bytes]):
-    import numpy as np
     data = b"".join(items)
     n = len(items)
-    off = np.zeros(n + 1, np.uint64)
-    if n:
+    if n < 512:
+        # plain-Python offsets beat the numpy round-trip for the small
+        # per-block calls (merkle trees of ~10-100 leaves) that dominate
+        # the sync loop
+        off = [0] * (n + 1)
+        t = 0
+        for i, it in enumerate(items):
+            t += len(it)
+            off[i + 1] = t
+        offsets = (ctypes.c_uint64 * (n + 1))(*off)
+    else:
+        import numpy as np
+        off = np.zeros(n + 1, np.uint64)
         np.cumsum(np.fromiter((len(it) for it in items), np.uint64, n),
                   out=off[1:])
-    offsets = (ctypes.c_uint64 * (n + 1)).from_buffer_copy(off.tobytes())
+        offsets = (ctypes.c_uint64 * (n + 1)).from_buffer_copy(
+            off.tobytes())
     buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
         data or b"\x00")
     return buf, offsets
@@ -109,15 +179,28 @@ def merkle_root(items: List[bytes]) -> Optional[bytes]:
     return bytes(out)
 
 
-def merkle_root_from_digests(digests: List[bytes]) -> Optional[bytes]:
+def merkle_root_from_digests(digests) -> Optional[bytes]:
+    """digests: list of 32-byte hashes, OR a bytes-like blob of
+    concatenated digests (len % 32 == 0) — the blob path avoids a
+    join+copy for callers that maintain a flat digest buffer."""
     lib = _load()
     if lib is None:
         return None
-    data = b"".join(digests)
-    buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
-        data or b"\x00")
+    if isinstance(digests, (bytes, bytearray, memoryview)):
+        data = digests
+        n = len(data) // 32
+        if isinstance(data, bytearray):
+            buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer(data)
+        else:
+            buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
+                data or b"\x00")
+    else:
+        data = b"".join(digests)
+        n = len(digests)
+        buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
+            data or b"\x00")
     out = (ctypes.c_uint8 * 32)()
-    lib.tm_merkle_root_from_digests(buf, len(digests), out)
+    lib.tm_merkle_root_from_digests(buf, n, out)
     return bytes(out)
 
 

@@ -69,14 +69,20 @@ def root_host(items: list[bytes]) -> bytes:
     return root_from_digests_host([leaf_hash(it) for it in items])
 
 
-def root_from_digests_host(digests: list[bytes]) -> bytes:
-    n = len(digests)
+def root_from_digests_host(digests) -> bytes:
+    """digests: list of 32B hashes or a flat bytes-like blob (len%32==0,
+    passed through to the native kernel without a join/copy)."""
+    flat = isinstance(digests, (bytes, bytearray, memoryview))
+    n = len(digests) // 32 if flat else len(digests)
     if n == 0:
         return _final_hash(0, EMPTY_DIGEST)
     from tendermint_tpu import native
-    out = native.merkle_root_from_digests(list(digests))
+    out = native.merkle_root_from_digests(
+        digests if flat else list(digests))
     if out is not None:
         return out
+    if flat:
+        digests = [bytes(digests[32 * i:32 * (i + 1)]) for i in range(n)]
     level = list(digests) + [EMPTY_DIGEST] * (_padded_size(n) - n)
     while len(level) > 1:
         level = [node_hash(level[i], level[i + 1]) for i in range(0, len(level), 2)]

@@ -215,7 +215,8 @@ def update_state(state: State, block_id: BlockID, block: Block,
         state.last_block_total_tx + block.header.num_txs
     new_state.last_block_id = block_id
     new_state.last_block_time_ns = block.header.time_ns
-    new_state.last_validators = state.validators.copy()
+    # shared, not copied: published sets are immutable (see State.copy)
+    new_state.last_validators = state.validators
     new_state.validators = validators
     new_state.last_height_validators_changed = last_height_vals_changed
     new_state.consensus_params = params

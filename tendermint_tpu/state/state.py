@@ -34,11 +34,13 @@ class State:
     app_hash: bytes = b""
 
     def copy(self) -> "State":
-        s = replace(self)
-        s.validators = self.validators.copy() if self.validators else None
-        s.last_validators = (
-            self.last_validators.copy() if self.last_validators else None)
-        return s
+        # valsets are SHARED, not copied: a published ValidatorSet is
+        # never mutated in place — the only in-place mutation in the
+        # codebase (increment_accum, consensus/state.py + state/
+        # execution.py) always operates on a freshly .copy()'d set
+        # before publishing it. Copying two valsets per State.copy was
+        # a top-5 cost of the fast-sync loop.
+        return replace(self)
 
     def is_empty(self) -> bool:
         return self.validators is None

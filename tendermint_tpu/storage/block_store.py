@@ -35,6 +35,30 @@ def _seen_commit_key(h: int) -> bytes:
     return b"BS:SC:%020d" % h
 
 
+# Parts are stored RAW, not as hex-JSON: a part is up to 64 KiB of block
+# bytes, and hex-JSON doubles the stored size and burns an encode/decode
+# per part in the sync hot loop (the reference stores go-wire binary,
+# blockchain/store.go:167-200). Layout:
+#   u32le index | u8 n_proof | n_proof * 32B aunts | payload
+_PART_HDR = 5
+
+
+def _pack_part(part: Part) -> bytes:
+    assert len(part.proof) < 256
+    return (part.index.to_bytes(4, "little")
+            + bytes([len(part.proof)]) + b"".join(part.proof)
+            + part.payload)
+
+
+def _unpack_part(raw: bytes) -> Part:
+    index = int.from_bytes(raw[:4], "little")
+    n = raw[4]
+    off = _PART_HDR + 32 * n
+    proof = [raw[_PART_HDR + 32 * i:_PART_HDR + 32 * (i + 1)]
+             for i in range(n)]
+    return Part(index, raw[off:], proof)
+
+
 @dataclass
 class BlockMeta:
     """Summary row for a stored block (blockchain/store.go BlockMeta)."""
@@ -78,12 +102,13 @@ class BlockStore:
         pairs = [(_meta_key(h), encoding.cdumps(meta.to_obj()))]
         for i in range(part_set.total):
             part = part_set.get_part(i)
-            pairs.append((_part_key(h, i), encoding.cdumps(part.to_obj())))
+            pairs.append((_part_key(h, i), _pack_part(part)))
         if block.last_commit is not None:
-            pairs.append((_commit_key(h - 1),
-                          encoding.cdumps(block.last_commit.to_obj())))
-        pairs.append((_seen_commit_key(h),
-                      encoding.cdumps(seen_commit.to_obj())))
+            # cached canonical bytes: the same commit object is stored
+            # twice across adjacent heights (seen_commit at h, then
+            # last_commit inside block h+1)
+            pairs.append((_commit_key(h - 1), block.last_commit.to_bytes()))
+        pairs.append((_seen_commit_key(h), seen_commit.to_bytes()))
         pairs.append((_HEIGHT_KEY, b"%d" % h))
         self.db.set_batch(pairs)  # one transaction: atomic + one commit
 
@@ -93,7 +118,7 @@ class BlockStore:
 
     def load_block_part(self, h: int, i: int) -> Optional[Part]:
         raw = self.db.get(_part_key(h, i))
-        return None if raw is None else Part.from_obj(encoding.cloads(raw))
+        return None if raw is None else _unpack_part(raw)
 
     def load_block(self, h: int) -> Optional[Block]:
         """Reassemble the block from its parts (blockchain/store.go:70-90)."""

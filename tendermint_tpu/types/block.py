@@ -45,8 +45,23 @@ class BlockID:
     def is_zero(self) -> bool:
         return not self.hash and self.parts.is_zero()
 
+    def __setattr__(self, name, value):
+        # field writes invalidate the cached key string (nested
+        # parts-field mutation is not covered; parts are replaced, not
+        # mutated, everywhere in the codebase)
+        if not name.startswith("_"):
+            self.__dict__.pop("_key", None)
+        object.__setattr__(self, name, value)
+
     def key(self) -> str:
-        return self.hash.hex() + "/" + str(self.parts.total) + "/" + self.parts.hash.hex()
+        # cached: key() is called per vote on hot paths (dict keys,
+        # equality in the reference idiom) and hexes 64 bytes each time
+        k = self.__dict__.get("_key")
+        if k is None:
+            k = (self.hash.hex() + "/" + str(self.parts.total) + "/"
+                 + self.parts.hash.hex())
+            self.__dict__["_key"] = k
+        return k
 
     def short(self) -> str:
         return self.hash.hex()[:8] if self.hash else "<nil>"
@@ -59,10 +74,13 @@ class BlockID:
         return cls(bytes.fromhex(o["hash"]), PartSetHeader.from_obj(o["parts"]))
 
     def __eq__(self, other):
-        return isinstance(other, BlockID) and self.key() == other.key()
+        # raw field compare — no hex round-trip on the hot path
+        return isinstance(other, BlockID) and self.hash == other.hash \
+            and self.parts.total == other.parts.total \
+            and self.parts.hash == other.parts.hash
 
     def __hash__(self):
-        return hash(self.key())
+        return hash((self.hash, self.parts.total, self.parts.hash))
 
 
 @dataclass
@@ -197,6 +215,7 @@ class Commit:
         if not name.startswith("_"):
             self.__dict__.pop("_hash", None)
             self.__dict__.pop("_obj", None)
+            self.__dict__.pop("_cbytes", None)
             self.__dict__.pop("_fp", None)
         object.__setattr__(self, name, value)
 
@@ -204,15 +223,19 @@ class Commit:
         # __setattr__ can't see IN-PLACE mutation (precommits[i].signature
         # = ..., the tamper-test idiom), so the caches are additionally
         # keyed on a fingerprint of every sign-relevant vote field plus
-        # the commit's own block id — tuple compares over small values,
-        # far cheaper than the O(V) canonical encodes they guard
-        fp = (self.block_id.key(),
+        # the commit's own block id — tuple compares over raw bytes/ints
+        # (no hexing), far cheaper than the O(V) encodes they guard
+        fp = (self.block_id.hash, self.block_id.parts.total,
+              self.block_id.parts.hash,
               tuple((v.signature, v.timestamp_ns, v.height, v.round,
-                     int(v.type), v.block_id.key()) if v is not None
-                    else None for v in self.precommits))
+                     int(v.type), v.block_id.hash, v.block_id.parts.total,
+                     v.block_id.parts.hash)
+                    if v is not None else None
+                    for v in self.precommits))
         if self.__dict__.get("_fp") != fp:
             self.__dict__.pop("_hash", None)
             self.__dict__.pop("_obj", None)
+            self.__dict__.pop("_cbytes", None)
             self.__dict__["_fp"] = fp
 
     def hash(self) -> bytes:
@@ -234,6 +257,18 @@ class Commit:
                 "precommits": [v.to_obj() if v else None
                                for v in self.precommits]}
         return self.__dict__["_obj"]
+
+    def to_bytes(self) -> bytes:
+        # cached canonical encoding (same invalidation contract as
+        # hash()): the store writes each commit twice per height
+        # (last_commit + seen_commit of adjacent blocks) and each encode
+        # walks V vote objects
+        self._check_cache_fresh()
+        b = self.__dict__.get("_cbytes")
+        if b is None:
+            b = encoding.cdumps(self.to_obj())
+            self.__dict__["_cbytes"] = b
+        return b
 
     @classmethod
     def from_obj(cls, o):

@@ -28,10 +28,35 @@ def _canon(obj: Any) -> Any:
     return obj
 
 
-def cdumps(obj: Any) -> bytes:
-    """Canonical JSON bytes of a plain obj tree (dicts/lists/ints/str/bytes/None)."""
+def _pure_cdumps(obj: Any) -> bytes:
+    """The specification path: _canon + json.dumps. The native encoder
+    must be byte-equal to this (differential-tested in
+    tests/test_native.py); it falls back here for shapes it rejects."""
     return json.dumps(_canon(obj), sort_keys=True, separators=(",", ":"),
                       ensure_ascii=False).encode()
+
+
+_native_dumps = None    # resolved lazily: (fn, FallbackExc) or False
+_native_state: Any = None
+
+
+def cdumps(obj: Any) -> bytes:
+    """Canonical JSON bytes of a plain obj tree (dicts/lists/ints/str/
+    bytes/None). Uses the native encoder (native/codec.cpp) when built —
+    canonical encoding is the single hottest host operation in the sync
+    loop — with automatic fallback to the pure path."""
+    global _native_state
+    if _native_state is None:
+        from tendermint_tpu import native
+        mod = native.codec()
+        _native_state = (mod.canonical_dumps, mod.Fallback) if mod else False
+    if _native_state is not False:
+        fn, fallback_exc = _native_state
+        try:
+            return fn(obj)
+        except fallback_exc:
+            pass
+    return _pure_cdumps(obj)
 
 
 def cloads(data: bytes) -> Any:

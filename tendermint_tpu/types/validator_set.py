@@ -37,7 +37,13 @@ class Validator:
         return _address_memo(self.pubkey)
 
     def copy(self) -> "Validator":
-        return Validator(self.pubkey, self.voting_power, self.accum)
+        # __new__ + direct writes: dataclass __init__ shows up in the
+        # sync-loop profile at V copies per set copy
+        v = Validator.__new__(Validator)
+        v.pubkey = self.pubkey
+        v.voting_power = self.voting_power
+        v.accum = self.accum
+        return v
 
     def compare_accum(self, other: "Validator") -> "Validator":
         """Higher accum wins; ties break to lower address
@@ -80,7 +86,14 @@ class ValidatorSet:
         return len(self.validators)
 
     def copy(self) -> "ValidatorSet":
-        vs = ValidatorSet(self.validators)
+        # fast path: a copy has identical addresses in identical order
+        # (updates construct NEW sets through __init__), so the sorted
+        # order, duplicate check and addr->index map carry over — the
+        # index dict is shared, which is safe because nothing mutates a
+        # set's membership in place
+        vs = ValidatorSet.__new__(ValidatorSet)
+        vs.validators = [v.copy() for v in self.validators]
+        vs._index = self._index
         vs._proposer = self._proposer.copy() if self._proposer else None
         vs._hash = self._hash
         return vs
@@ -159,6 +172,14 @@ class ValidatorSet:
         items = []
         item_power = []
         round_ = commit.round()
+        # sign-bytes template per distinct block_id in this commit:
+        # within one commit the votes differ only in timestamp (and
+        # occasionally block_id for nil votes), so the canonical prefix/
+        # suffix around the timestamp is built once per block_id via the
+        # ONE layout definition (vote.sign_bytes_template) — pinned by
+        # test_commit_items_sign_bytes_match
+        from tendermint_tpu.types.vote import sign_bytes_template
+        tmpl: dict = {}
         for idx, pc in enumerate(commit.precommits):
             if pc is None:
                 continue
@@ -167,8 +188,16 @@ class ValidatorSet:
             if pc.height != height or pc.round != round_:
                 raise ValueError("commit vote height/round mismatch")
             val = self.validators[idx]
-            items.append((val.pubkey, pc.sign_bytes(chain_id), pc.signature))
-            item_power.append((val.voting_power, pc.block_id == block_id))
+            bid = pc.block_id
+            tkey = (bid.hash, bid.parts.total, bid.parts.hash)
+            t = tmpl.get(tkey)
+            if t is None:
+                t = sign_bytes_template(chain_id, bid, height, round_,
+                                        pc.type)
+                tmpl[tkey] = t
+            sb = (t[0] + str(pc.timestamp_ns) + t[1]).encode()
+            items.append((val.pubkey, sb, pc.signature))
+            item_power.append((val.voting_power, bid == block_id))
         return items, item_power
 
     def check_commit_results(self, ok, item_power) -> None:

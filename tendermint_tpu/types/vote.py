@@ -23,6 +23,25 @@ def now_ns() -> int:
     return time.time_ns()
 
 
+def sign_bytes_template(chain_id: str, block_id, height: int, round_: int,
+                        type_: int) -> tuple:
+    """(prefix, suffix) strings around the timestamp of the canonical
+    vote sign bytes — THE single definition of the vote sign-byte
+    layout. Vote.sign_bytes fills one timestamp; batch verifiers
+    (ValidatorSet.commit_verification_items) reuse one template for a
+    whole commit, whose votes differ only in timestamp per block_id."""
+    import json
+    cid = json.dumps(chain_id, ensure_ascii=False)
+    return (
+        f'{{"@chain_id":{cid},"@type":"vote",'
+        f'"block_id":{{"hash":"{block_id.hash.hex()}",'
+        f'"parts":{{"hash":"{block_id.parts.hash.hex()}",'
+        f'"total":{block_id.parts.total}}}}},'
+        f'"height":{height},"round":{round_},'
+        f'"timestamp_ns":',
+        f',"type":{type_}}}')
+
+
 @dataclass
 class Vote:
     validator_address: bytes
@@ -55,17 +74,9 @@ class Vote:
         prepared), and the generic dict walk costs ~20us vs ~2us here.
         Byte-identical to encoding.cdumps(self.sign_obj(chain_id)) —
         pinned by test_types.test_vote_sign_bytes_fast_path."""
-        import json
-        bid = self.block_id
-        cid = json.dumps(chain_id, ensure_ascii=False)
-        return (
-            f'{{"@chain_id":{cid},"@type":"vote",'
-            f'"block_id":{{"hash":"{bid.hash.hex()}",'
-            f'"parts":{{"hash":"{bid.parts.hash.hex()}",'
-            f'"total":{bid.parts.total}}}}},'
-            f'"height":{self.height},"round":{self.round},'
-            f'"timestamp_ns":{self.timestamp_ns},"type":{self.type}}}'
-        ).encode()
+        pre, suf = sign_bytes_template(chain_id, self.block_id,
+                                       self.height, self.round, self.type)
+        return (pre + str(self.timestamp_ns) + suf).encode()
 
     def to_obj(self):
         # cached per signature value: a commit re-encodes its V votes

@@ -107,3 +107,61 @@ def test_native_speedup_on_large_tree():
 
     assert native_root == want
     assert t_native < t_py, (t_native, t_py)
+
+
+def test_codec_differential_vs_pure():
+    """native/codec.cpp canonical_dumps must be byte-equal to the pure
+    _canon+json.dumps specification path on randomized object trees,
+    raise TypeError on floats, and Fallback (-> pure path) on non-str
+    dict keys."""
+    import random
+    import string
+
+    import pytest
+
+    from tendermint_tpu import native
+    from tendermint_tpu.types import encoding
+
+    mod = native.codec()
+    if mod is None:
+        pytest.skip("native codec unavailable")
+
+    rng = random.Random(1234)
+
+    def rand_obj(depth=0):
+        r = rng.random()
+        if depth > 4 or r < 0.25:
+            return rng.choice([
+                None, True, False,
+                rng.randrange(-2 ** 70, 2 ** 70),
+                rng.randrange(-1000, 1000),
+                ''.join(rng.choice(string.printable)
+                        for _ in range(rng.randrange(0, 30))),
+                'unicode: ñ→🎉 \x01\x1f "quoted" back\\slash',
+                rng.randbytes(rng.randrange(0, 40)),
+                bytearray(rng.randbytes(5)),
+            ])
+        if r < 0.55:
+            return {''.join(rng.choice(string.ascii_letters + 'é\n"\\')
+                            for _ in range(rng.randrange(1, 10))):
+                    rand_obj(depth + 1)
+                    for _ in range(rng.randrange(0, 8))}
+        return [rand_obj(depth + 1) for _ in range(rng.randrange(0, 8))]
+
+    for _ in range(1500):
+        o = rand_obj()
+        assert mod.canonical_dumps(o) == encoding._pure_cdumps(o), o
+
+    class Wrapped:
+        def to_obj(self):
+            return {"x": b"\x01\x02", "n": [1, None]}
+
+    assert mod.canonical_dumps(Wrapped()) == \
+        encoding._pure_cdumps(Wrapped())
+
+    with pytest.raises(TypeError):
+        mod.canonical_dumps({"a": 1.5})
+    with pytest.raises(mod.Fallback):
+        mod.canonical_dumps({1: "a"})
+    # cdumps itself falls back and matches pure for non-str keys
+    assert encoding.cdumps({1: "a"}) == encoding._pure_cdumps({1: "a"})

@@ -513,3 +513,21 @@ def test_verify_commit_any_invalid_overlap_signature_fails():
     commit = _commit_for(old, privs, 7, bid, garbage={bad_addr})
     with pytest.raises(ValueError, match="invalid signature"):
         old.verify_commit_any(old, CHAIN, bid, 7, commit, verifier=PYV)
+
+
+def test_commit_items_sign_bytes_match_vote_sign_bytes():
+    """commit_verification_items' templated sign-bytes fast path must be
+    byte-identical to Vote.sign_bytes (which is itself pinned to the
+    generic canonical encoding)."""
+    vs, privs = make_valset(4)
+    bid = make_block_id()
+    vset = VoteSet(CHAIN, 3, 1, VoteType.PRECOMMIT, vs, verifier=PYV)
+    for i in range(4):
+        vset.add_vote(signed_vote(privs[i], i, 3, 1, VoteType.PRECOMMIT,
+                                  bid, ts=5000 + 17 * i))
+    commit = vset.make_commit()
+    items, _ = vs.commit_verification_items(CHAIN, bid, 3, commit)
+    got = [sb for _, sb, _ in items]
+    want = [pc.sign_bytes(CHAIN) for pc in commit.precommits
+            if pc is not None]
+    assert got == want
