@@ -44,6 +44,83 @@ def scalar_baseline_rate(pubs, msgs, sigs, budget_s=3.0) -> float:
     return n_done / (time.perf_counter() - t0)
 
 
+def verify_commit_100(n_vals: int = 100) -> dict:
+    """BASELINE config 2: ValidatorSet.VerifyCommit on a 100-validator
+    commit — the full product path (structural checks + sign-bytes
+    collect + device batch + power check), best-of trials, vs the
+    scalar one-verify-per-precommit model."""
+    from bench_util import ScalarVerifier
+    from tendermint_tpu.models.verifier import BatchVerifier
+    from tendermint_tpu.types import PrivKey, Validator, ValidatorSet
+    from tendermint_tpu.types.block import BlockID, Commit, PartSetHeader
+    from tendermint_tpu.types.vote import Vote, VoteType
+    from bench_util import fast_signer
+
+    keys = [PrivKey.generate((i + 1).to_bytes(32, "little"))
+            for i in range(n_vals)]
+    vs = ValidatorSet([Validator(k.pubkey.ed25519, 10) for k in keys])
+    sign = {k.pubkey.address: fast_signer((i + 1).to_bytes(32, "little"))
+            for i, k in enumerate(keys)}
+    bid = BlockID(b"\x42" * 32, PartSetHeader(1, b"\x24" * 32))
+    precommits = [None] * n_vals
+    for idx, val in enumerate(vs.validators):
+        v = Vote(val.address, idx, 7, 0, 1000 + idx, VoteType.PRECOMMIT,
+                 bid)
+        v.signature = sign[val.address](v.sign_bytes("bench-commit"))
+        precommits[idx] = v
+    commit = Commit(bid, precommits)
+
+    jv = BatchVerifier("jax")
+    vs.verify_commit("bench-commit", bid, 7, commit, verifier=jv)  # warm
+
+    # latency arm: one synchronous VerifyCommit. On tunneled TPU links
+    # this is dominated by the per-dispatch round trip (~100ms), not
+    # device compute (~1ms for 100 sigs) — reported as-is.
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        vs.verify_commit("bench-commit", bid, 7, commit, verifier=jv)
+        best = min(best, time.perf_counter() - t0)
+
+    # throughput arm: 16 commits in flight via the async product path
+    # (collect + verify_async + check), the shape a loaded node actually
+    # runs — round trips amortize across in-flight commits up to the
+    # tunnel's multiplexing limit (~8 concurrent; a locally-attached
+    # chip has ~1ms dispatches and none of this ceiling)
+    from concurrent.futures import ThreadPoolExecutor
+    n_flight = 16
+    thr = float("inf")
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        for _ in range(3):
+            t0 = time.perf_counter()
+            futs = []
+            for _ in range(n_flight):
+                items, item_power = vs.commit_verification_items(
+                    "bench-commit", bid, 7, commit)
+                futs.append((pool.submit(jv.verify_async(items)),
+                             item_power))
+            for fut, item_power in futs:
+                vs.check_commit_results(fut.result(), item_power)
+            thr = min(thr, (time.perf_counter() - t0) / n_flight)
+
+    sv = ScalarVerifier()
+    t0 = time.perf_counter()
+    reps = 0
+    while time.perf_counter() - t0 < 2.0:
+        vs.verify_commit("bench-commit", bid, 7, commit, verifier=sv)
+        reps += 1
+    scalar_s = (time.perf_counter() - t0) / reps
+    return {
+        "commits_per_sec": round(1 / thr, 1),
+        "verifies_per_sec": round(n_vals / thr, 1),
+        "ms_per_commit_latency": round(best * 1e3, 3),
+        "ms_per_commit_throughput": round(thr * 1e3, 3),
+        "n_vals": n_vals,
+        "scalar_commits_per_sec": round(1 / scalar_s, 1),
+        "vs_baseline": round(scalar_s / thr, 2),
+    }
+
+
 def main() -> int:
     import numpy as np
     import jax
@@ -105,11 +182,17 @@ def main() -> int:
         "scalar_cpu_rate": round(base_rate, 1),
     }
 
-    # BASELINE configs 4 + 5 (fast-sync replay, lite chain certify):
-    # folded into extra so the driver captures one line with all three.
-    # Skippable (TM_BENCH_HEADLINE_ONLY=1) and non-fatal — the headline
-    # metric must survive a failure in the secondary benches.
+    # All five BASELINE configs in ONE driver line: 1 testnet commit
+    # rate, 2 VerifyCommit-100 microbench, 3 the headline above, 4
+    # fast-sync replay at 5120 blocks, 5 lite chain certify (ratio arm
+    # at 64 vals + 100k-header sustained arm). Skippable
+    # (TM_BENCH_HEADLINE_ONLY=1) and non-fatal — the headline metric
+    # must survive a failure in any secondary bench.
     if not os.environ.get("TM_BENCH_HEADLINE_ONLY"):
+        try:
+            extra["commit100"] = verify_commit_100()
+        except Exception as e:  # pragma: no cover
+            extra["commit100_error"] = repr(e)
         try:
             import bench_fastsync
             extra["fastsync"] = bench_fastsync.run(
@@ -118,9 +201,17 @@ def main() -> int:
             extra["fastsync_error"] = repr(e)
         try:
             import bench_lite
-            extra["lite"] = bench_lite.run(1000, 64)
+            extra["lite"] = bench_lite.run(2000, 64)
+            # 8 vals: headers/sec is host-per-header-bound at this
+            # valcount either way, and build time halves vs 16
+            extra["lite_100k"] = bench_lite.run_large(100_000, 8)
         except Exception as e:  # pragma: no cover
             extra["lite_error"] = repr(e)
+        try:
+            import bench_testnet
+            extra["testnet"] = bench_testnet.run(30, 4, 1000)
+        except Exception as e:  # pragma: no cover
+            extra["testnet_error"] = repr(e)
 
     print(json.dumps({
         "metric": "ed25519_batch_verify_10k_commit",

@@ -1,0 +1,154 @@
+"""4-validator testnet commit-rate bench (BASELINE.json config 1).
+
+The reference's config-1 baseline is a 4-validator local testnet running
+the kvstore ABCI app with 1000-tx blocks. Here: four in-process
+ConsensusStates over a full-mesh relay (the same wiring the consensus
+test nets use), MockTicker-driven so the measured rate is the ENGINE's
+throughput — proposal build + part gossip + vote verify + apply — not
+the configured wall-clock timeouts. Each proposer reaps 1000 txs per
+block from its mempool.
+
+Standalone: `python bench_testnet.py [n_blocks] [n_vals] [n_txs]`
+prints one JSON line. bench.py folds `run()` into `extra` for the
+driver.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from bench_util import enable_tpu_compilation_cache
+
+enable_tpu_compilation_cache()  # must precede any jax import
+
+
+class _BenchMempool:
+    """Endless reap: always has the next block's txs ready."""
+
+    def __init__(self, n_txs: int):
+        self.n_txs = n_txs
+        self._next = 0
+        self.committed = 0
+
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def size(self):
+        return self.n_txs
+
+    def reap(self, max_txs: int):
+        base = self._next
+        k = self.n_txs if max_txs < 0 else min(self.n_txs, max_txs)
+        return [b"bench/k%d=v%d" % (base + i, i) for i in range(k)]
+
+    def update(self, height, txs):
+        self._next += len(txs)
+        self.committed += len(txs)
+
+    def txs_available(self):
+        return True
+
+
+def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.consensus import ConsensusState, MockTicker
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.storage import BlockStore, MemDB, StateStore
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+    from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n_vals)]
+    gen = GenesisDoc(chain_id="bench-net", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+
+    nodes = []
+    for k in keys:
+        conns = AppConns(local_client_creator(KVStoreApp()))
+        state_store = StateStore(MemDB())
+        block_store = BlockStore(MemDB())
+        state = state_store.load_or_genesis(gen)
+        conns.consensus.init_chain(
+            [ValidatorUpdate(v.pubkey, v.voting_power)
+             for v in state.validators.validators], gen.chain_id)
+        mp = _BenchMempool(n_txs)
+        exec_ = BlockExecutor(state_store, conns.consensus, mempool=mp)
+        cs = ConsensusState(
+            make_test_config().consensus, state, exec_, block_store,
+            mempool=mp, priv_validator=PrivValidator(LocalSigner(k)),
+            ticker_factory=MockTicker)
+        nodes.append(cs)
+
+    # full-mesh relay of proposal/part/vote broadcasts
+    for i, src in enumerate(nodes):
+        def relay(msg, i=i):
+            for j, dst in enumerate(nodes):
+                if j != i and msg["type"] in ("proposal", "block_part",
+                                              "vote"):
+                    dst.submit(dict(msg), peer_id=f"node{i}")
+        src.broadcast_hooks.append(relay)
+
+    def fire_all():
+        n = 0
+        for node in nodes:
+            if node.ticker.fire_next() is not None:
+                n += 1
+        return n
+
+    for node in nodes:
+        node.start()
+
+    def run_to(height, max_ticks):
+        for _ in range(max_ticks):
+            if all(n.state.last_block_height >= height for n in nodes):
+                return True
+            fire_all()
+        return all(n.state.last_block_height >= height for n in nodes)
+
+    # warmup: first blocks pay kernel compiles + app-hash settling
+    assert run_to(2, 400), "testnet warmup stalled"
+
+    h0 = min(n.state.last_block_height for n in nodes)
+    tx0 = nodes[0].mempool.committed
+    t0 = time.perf_counter()
+    target = h0 + n_blocks
+    assert run_to(target, 400 * n_blocks), "testnet bench stalled"
+    dt = time.perf_counter() - t0
+    blocks = min(n.state.last_block_height for n in nodes) - h0
+    txs = nodes[0].mempool.committed - tx0
+
+    for node in nodes:
+        node.stop()
+    return {
+        "blocks_per_sec": round(blocks / dt, 2),
+        "txs_per_sec": round(txs / dt, 1),
+        "blocks": blocks, "n_vals": n_vals, "txs_per_block": n_txs,
+        "seconds": round(dt, 3),
+    }
+
+
+def main() -> int:
+    n_blocks = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    n_txs = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
+    r = run(n_blocks, n_vals, n_txs)
+    print(json.dumps({
+        "metric": "testnet_commit_rate",
+        "value": r["blocks_per_sec"],
+        "unit": "blocks/sec",
+        "vs_baseline": 0.0,
+        "extra": r,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,13 +240,18 @@ class BlockchainReactor(Reactor):
                 return applied
             ps_now = (self.state.consensus_params
                       .block_gossip.block_part_size_bytes)
+            rebuilt = False
             if ps_now != part_size:
                 # consensus params changed inside the pipeline window:
-                # the pre-built part set used the stale size — rebuild
+                # the pre-built part set used the stale size — rebuild,
+                # and DISCARD the batched results too (their
+                # for-this-block flags were computed against the old
+                # block_id and would zero out the counted power)
                 parts, block_id = self._parts_and_id(block)
+                rebuilt = True
             vs_now = self.state.validators
             try:
-                if item_power is not None and \
+                if not rebuilt and item_power is not None and \
                         vs_now.hash() == batch_valset_hash:
                     vs_now.check_commit_results(ok[lo:lo + n], item_power)
                 else:

@@ -50,6 +50,22 @@ BATCH_CHUNK = 8192
 _mesh_kernels: dict[int, Callable] = {}
 _mesh_lock = threading.Lock()
 
+# Shared pool for fetching chunk results: tunneled TPU links execute and
+# transfer at fetch time and serialize per array, so fetching a
+# multi-chunk batch's verdicts from several threads overlaps the
+# per-chunk round trips (measured ~2x on 4 chunks).
+_fetch_pool = None
+
+
+def _fetch_pool_get():
+    global _fetch_pool
+    with _mesh_lock:
+        if _fetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _fetch_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="tm-verify-fetch")
+        return _fetch_pool
+
 
 def _mesh_kernel(n_devices: int) -> Callable:
     with _mesh_lock:
@@ -178,8 +194,13 @@ class BatchVerifier:
 
         def resolve() -> np.ndarray:
             out = np.zeros(n, np.bool_)
-            for lo, hi, res, pre in pending:
-                out[lo:hi] = np.asarray(res)[:hi - lo] & pre
+            if len(pending) > 1:
+                arrs = list(_fetch_pool_get().map(
+                    lambda p: np.asarray(p[2]), pending))
+            else:
+                arrs = [np.asarray(pending[0][2])]
+            for (lo, hi, _res, pre), arr in zip(pending, arrs):
+                out[lo:hi] = arr[:hi - lo] & pre
             return out
 
         return resolve

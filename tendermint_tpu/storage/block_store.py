@@ -38,21 +38,28 @@ def _seen_commit_key(h: int) -> bytes:
 # Parts are stored RAW, not as hex-JSON: a part is up to 64 KiB of block
 # bytes, and hex-JSON doubles the stored size and burns an encode/decode
 # per part in the sync hot loop (the reference stores go-wire binary,
-# blockchain/store.go:167-200). Layout:
-#   u32le index | u8 n_proof | n_proof * 32B aunts | payload
-_PART_HDR = 5
+# blockchain/store.go:167-200). Layout (format byte 0x01):
+#   0x01 | u32le index | u8 n_proof | n_proof * 32B aunts | payload
+# Rows written by the earlier hex-JSON format start with '{' and are
+# still readable; any other leading byte fails loudly.
+_PART_FMT = 0x01
+_PART_HDR = 6
 
 
 def _pack_part(part: Part) -> bytes:
     assert len(part.proof) < 256
-    return (part.index.to_bytes(4, "little")
+    return (bytes([_PART_FMT]) + part.index.to_bytes(4, "little")
             + bytes([len(part.proof)]) + b"".join(part.proof)
             + part.payload)
 
 
 def _unpack_part(raw: bytes) -> Part:
-    index = int.from_bytes(raw[:4], "little")
-    n = raw[4]
+    if raw[:1] == b"{":  # legacy hex-JSON row
+        return Part.from_obj(encoding.cloads(raw))
+    if raw[0] != _PART_FMT:
+        raise ValueError(f"unknown block-part format 0x{raw[0]:02x}")
+    index = int.from_bytes(raw[1:5], "little")
+    n = raw[5]
     off = _PART_HDR + 32 * n
     proof = [raw[_PART_HDR + 32 * i:_PART_HDR + 32 * (i + 1)]
              for i in range(n)]

@@ -36,7 +36,8 @@ def _pure_cdumps(obj: Any) -> bytes:
                       ensure_ascii=False).encode()
 
 
-_native_dumps = None    # resolved lazily: (fn, FallbackExc) or False
+# resolved lazily on first cdumps: (canonical_dumps, Fallback) once the
+# native codec builds, False when unavailable
 _native_state: Any = None
 
 
