@@ -74,7 +74,7 @@ class BlockchainReactor(Reactor):
     def start(self) -> None:
         if self.fast_sync:
             self._thread = threading.Thread(
-                target=self._pool_routine, daemon=True, name="fastsync")
+                target=self._pool_routine, daemon=True, name="tm-fastsync")
             self._thread.start()
 
     def stop(self) -> None:
@@ -82,6 +82,10 @@ class BlockchainReactor(Reactor):
         if self._resolver is not None:
             self._resolver.shutdown(wait=False)
             self._resolver = None
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+            self._thread = None
 
     # ----------------------------------------------------------------- peers
 

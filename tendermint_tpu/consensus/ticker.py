@@ -59,6 +59,7 @@ class TimeoutTicker:
             self._pending = ti
             self._timer = threading.Timer(ti.duration_s, self._fire, (ti,))
             self._timer.daemon = True
+            self._timer.name = "tm-timeout"
             self._timer.start()
 
     def _fire(self, ti: TimeoutInfo) -> None:
@@ -69,10 +70,19 @@ class TimeoutTicker:
         self._on_timeout(ti)
 
     def stop(self) -> None:
+        """Cancel the armed timer and JOIN an in-flight fire: a fire that
+        had already passed the cancel may be mid-callback (driving a
+        consensus transition); returning before it finishes lets a test
+        tear down streams the transition still logs to (the reference
+        enforces the same with leaktest, glide.yaml:46-48)."""
         with self._lock:
             self._stopped = True
-            if self._timer is not None:
-                self._timer.cancel()
+            timer = self._timer
+            self._timer = None
+        if timer is not None:
+            timer.cancel()
+            if timer is not threading.current_thread():
+                timer.join(timeout=5.0)
 
 
 class MockTicker:

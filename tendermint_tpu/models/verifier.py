@@ -173,10 +173,44 @@ class BatchVerifier:
         use_jax = self.backend == "jax" or (
             self.backend == "auto" and n > self.auto_threshold)
         if not use_jax:
-            from tendermint_tpu.utils import ed25519_ref as ref
-            out1 = np.array([ref.verify(p, m, s) for p, m, s in items],
+            # scalar host path, routed by key type (ed25519 | secp256k1)
+            from tendermint_tpu.types.keys import verify_any
+            out1 = np.array([verify_any(p, m, s) for p, m, s in items],
                             np.bool_)
             return lambda: out1
+        # mixed-key routing: 33-byte compressed-SEC1 pubkeys are
+        # secp256k1 — verified on host (off the TPU hot path by design,
+        # types/keys.py); everything else goes to the ed25519 device
+        # batch, where a non-ed25519 key fails its precheck anyway
+        secp_idx = [i for i, it in enumerate(items)
+                    if len(it[0]) == 33 and it[0][0] in (2, 3)]
+        if secp_idx:
+            from tendermint_tpu.types.keys import verify_any
+            secp_ok = {i: verify_any(*items[i]) for i in secp_idx}
+            ed_items = [it for i, it in enumerate(items)
+                        if i not in secp_ok]
+            if not ed_items:
+                out2 = np.zeros(n, np.bool_)
+                for i, ok in secp_ok.items():
+                    out2[i] = ok
+                return lambda: out2
+            inner = self.verify_async(ed_items)
+            self.stats["calls"] -= 1  # the outer call already counted
+            self.stats["sigs"] -= len(ed_items)
+
+            def resolve_mixed() -> np.ndarray:
+                ed_ok = inner()
+                out3 = np.zeros(n, np.bool_)
+                k = 0
+                for i in range(n):
+                    if i in secp_ok:
+                        out3[i] = secp_ok[i]
+                    else:
+                        out3[i] = ed_ok[k]
+                        k += 1
+                return out3
+
+            return resolve_mixed
         from tendermint_tpu.ops import ed25519
         if not self._mesh_resolved:
             self._resolve_mesh()

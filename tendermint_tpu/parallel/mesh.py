@@ -25,16 +25,30 @@ from tendermint_tpu.ops import curve, merkle, sha256
 from tendermint_tpu.ops.ed25519 import verify_kernel
 
 
+_mesh_cache: dict = {}
+_kernel_cache: dict = {}
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """Mesh over the first n devices, CACHED per device count: every
+    Mesh/shard_map/jit closure combination owns its own compile cache,
+    so handing out one object per size lets all callers (verifier,
+    dryrun, tests) share compiled executables."""
     devs = jax.devices()
     n = n_devices or len(devs)
-    return Mesh(np.array(devs[:n]), ("batch",))
+    if n not in _mesh_cache:
+        _mesh_cache[n] = Mesh(np.array(devs[:n]), ("batch",))
+    return _mesh_cache[n]
 
 
 def sharded_verify_kernel(mesh: Mesh):
     """Returns verify(pubkeys u8[N,32], r u8[N,32], s_bits i32[N,256],
     h_bits i32[N,256]) -> bool[N], with N sharded over mesh's `batch` axis.
-    Drop-in `kernel=` for ops.ed25519.verify_batch / BatchVerifier."""
+    Drop-in `kernel=` for ops.ed25519.verify_batch / BatchVerifier.
+    Cached per mesh (compiles are minutes on 1-core CI hosts)."""
+    key = ("verify", id(mesh))
+    if key in _kernel_cache:
+        return _kernel_cache[key]
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -47,13 +61,18 @@ def sharded_verify_kernel(mesh: Mesh):
     def _verify(pk, rb, sbits, hbits):
         return _local(pk, rb, sbits, hbits)
 
+    _kernel_cache[key] = _verify
     return _verify
 
 
 def sharded_merkle_root(mesh: Mesh):
     """Returns root(digests u8[M,32], n_leaves) -> u8[32]; leaf digests
     sharded over `batch`, local subtree reduced per chip, subtree roots
-    all_gathered and finished identically on every chip."""
+    all_gathered and finished identically on every chip. Cached per
+    mesh, like sharded_verify_kernel."""
+    key = ("merkle", id(mesh))
+    if key in _kernel_cache:
+        return _kernel_cache[key]
 
     n_dev = mesh.devices.size
 
@@ -80,6 +99,7 @@ def sharded_merkle_root(mesh: Mesh):
         return sha256.hash_fixed(
             jnp.concatenate([jnp.asarray(header), tree_root], axis=-1))
 
+    _kernel_cache[key] = _root
     return _root
 
 

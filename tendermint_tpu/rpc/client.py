@@ -207,9 +207,122 @@ class WSClient:
     def close(self) -> None:
         self.open = False
         try:
+            # wake the read loop blocked in recv (close alone wouldn't)
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
+
+
+class ReconnectingWSClient:
+    """WSClient with automatic reconnect — the reference's ws_client.go
+    (:30-140): on connection loss, redial with exponential backoff (+
+    jitter), re-subscribe every recorded query, and keep delivering
+    events through ONE stable queue across reconnects. Tracks per-call
+    latency (the reference hangs a go-metrics timer on the same spot).
+
+    call() during an outage raises RPCClientError immediately (the
+    reference errors too); subscriptions resume without caller action.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 max_backoff_s: float = 10.0, on_reconnect=None):
+        self.host, self.port, self.timeout = host, port, timeout
+        self.max_backoff_s = max_backoff_s
+        self.on_reconnect = on_reconnect
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        self.open = True
+        self.reconnects = 0
+        self.latency = {"count": 0, "total_s": 0.0, "max_s": 0.0,
+                        "min_s": None}
+        self._subs: list = []
+        self._lock = threading.RLock()
+        self._client: Optional[WSClient] = None
+        self._connect()
+        threading.Thread(target=self._monitor, daemon=True,
+                         name="tm-ws-reconnect").start()
+
+    def _connect(self) -> None:
+        c = WSClient(self.host, self.port, timeout=self.timeout)
+        c.events = self.events  # events survive the client swap
+        with self._lock:
+            if not self.open:
+                # close() raced the redial: don't leak the fresh conn
+                c.close()
+                raise OSError("client closed during reconnect")
+            self._client = c
+
+    def _monitor(self) -> None:
+        import random
+        import time as _t
+        backoff = 0.2
+        while self.open:
+            c = self._client
+            if c is not None and c.open:
+                backoff = 0.2
+                _t.sleep(0.1)
+                continue
+            try:
+                self._connect()
+            except OSError:
+                if not self.open:
+                    return
+                _t.sleep(backoff * (1 + random.random() / 2))
+                backoff = min(backoff * 2, self.max_backoff_s)
+                continue
+            self.reconnects += 1
+            with self._lock:
+                subs = list(self._subs)
+            try:
+                for q_ in subs:
+                    self._client.call("subscribe", query=q_)
+            except (OSError, RPCClientError):
+                continue  # died again mid-resubscribe; monitor retries
+            if self.on_reconnect is not None:
+                try:
+                    self.on_reconnect(self)
+                except Exception:
+                    pass
+
+    def call(self, method: str, timeout: float = 30.0, **params) -> Any:
+        import time as _t
+        c = self._client
+        if c is None or not c.open:
+            raise RPCClientError(-32000, "websocket disconnected "
+                                 "(reconnecting)")
+        t0 = _t.perf_counter()
+        result = c.call(method, timeout=timeout, **params)
+        dt = _t.perf_counter() - t0
+        lat = self.latency
+        lat["count"] += 1
+        lat["total_s"] += dt
+        lat["max_s"] = max(lat["max_s"], dt)
+        lat["min_s"] = dt if lat["min_s"] is None else min(lat["min_s"], dt)
+        return result
+
+    def subscribe(self, query: str) -> None:
+        with self._lock:
+            if query not in self._subs:
+                self._subs.append(query)
+        self.call("subscribe", query=query)
+
+    def unsubscribe(self, query: str) -> None:
+        with self._lock:
+            if query in self._subs:
+                self._subs.remove(query)
+        self.call("unsubscribe", query=query)
+
+    def next_event(self, timeout: float = 30.0) -> dict:
+        return self.events.get(timeout=timeout)
+
+    def close(self) -> None:
+        self.open = False
+        c = self._client
+        if c is not None:
+            c.close()
 
 
 class LocalClient:

@@ -44,6 +44,13 @@ MAX_BODY_BYTES = 1 << 20
 # not be able to grow them without bound.
 MAX_WS_CONNS = 100
 
+# Global cap on concurrent HTTP connections (each is one handler
+# thread in ThreadingHTTPServer): a plain connection flood must not
+# starve the host (reference: one http.Serve accept loop with the OS
+# backlog as the bound, http_server.go:77). Over-limit connections get
+# an immediate 503 WITHOUT spawning a thread.
+MAX_HTTP_CONNS = 200
+
 
 class RPCError(Exception):
     def __init__(self, code: int, message: str, data=None):
@@ -219,6 +226,13 @@ class WSConn:
             except Exception:
                 pass
         try:
+            # shutdown BEFORE close: the handler thread is blocked in
+            # recv on this socket, which pins the fd — a bare close()
+            # would neither wake it nor send FIN to the peer
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
@@ -232,14 +246,47 @@ def _rpc_response(id_, result=None, error: Optional[RPCError] = None) -> dict:
     return {"jsonrpc": "2.0", "id": id_, "result": result}
 
 
+class _BoundedHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a hard cap on live handler threads."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler, max_conns: int = MAX_HTTP_CONNS):
+        super().__init__(addr, handler)
+        self._conn_sema = threading.BoundedSemaphore(max_conns)
+
+    def process_request(self, request, client_address):
+        if not self._conn_sema.acquire(blocking=False):
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        try:
+            super().process_request(request, client_address)
+        except Exception:
+            self._conn_sema.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._conn_sema.release()
+
+
 class RPCServer:
     """funcmap + HTTP server; `register` mirrors RegisterRPCFuncs
     (handlers.go:27)."""
 
-    def __init__(self):
+    def __init__(self, max_http_conns: int = MAX_HTTP_CONNS):
         self.funcs: Dict[str, RPCFunc] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._ws_conns: list = []
+        self.max_http_conns = max_http_conns
 
     def register(self, name: str, fn: Callable, ws_only: bool = False) -> None:
         self.funcs[name] = RPCFunc(fn, ws_only=ws_only)
@@ -274,6 +321,9 @@ class RPCServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # a connection that sends nothing must not hold its handler
+            # thread (and its semaphore slot) forever
+            timeout = 60
 
             def log_message(self, *a):  # silence
                 pass
@@ -338,6 +388,9 @@ class RPCServer:
                 self.send_header("Connection", "Upgrade")
                 self.send_header("Sec-WebSocket-Accept", accept)
                 self.end_headers()
+                # undo the handler's slow-client read timeout: a healthy
+                # subscriber may legitimately send nothing for hours
+                self.request.settimeout(None)
                 ws = WSConn(self.request, self.client_address[0])
                 server._ws_conns.append(ws)
                 try:
@@ -348,8 +401,8 @@ class RPCServer:
                         server._ws_conns.remove(ws)
                     self.close_connection = True
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = _BoundedHTTPServer((host, port), Handler,
+                                         max_conns=self.max_http_conns)
         t = threading.Thread(target=self._httpd.serve_forever,
                              daemon=True, name="rpc-http")
         t.start()

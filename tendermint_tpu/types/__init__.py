@@ -12,7 +12,10 @@ re-designed rather than ported:
   (batched on TPU) instead of per-signature scalar calls
 """
 
-from tendermint_tpu.types.keys import PrivKey, PubKey, address_of
+from tendermint_tpu.types.keys import (PrivKey, PubKey, Secp256k1PrivKey,
+                                       Secp256k1PubKey, address_of,
+                                       privkey_from_obj, pubkey_from_obj,
+                                       verify_any)
 from tendermint_tpu.types.params import ConsensusParams
 from tendermint_tpu.types.vote import Vote, VoteType
 from tendermint_tpu.types.block import Block, BlockID, Commit, Header, PartSetHeader

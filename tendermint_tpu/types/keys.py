@@ -1,6 +1,12 @@
-"""Ed25519 key types. Address = first 20 bytes of SHA-256(pubkey)
-(the reference derives addresses via RIPEMD160, p2p/key.go:43-47; SHA-256
-is this rebuild's single hash primitive)."""
+"""Key types: Ed25519 (consensus-default, TPU-batched verification) and
+Secp256k1 (go-crypto's second key type — lite/performance_test.go:10-105
+exercises both). Address = first 20 bytes of SHA-256(pubkey) (the
+reference derives addresses via RIPEMD160, p2p/key.go:43-47; SHA-256 is
+this rebuild's single hash primitive).
+
+Secp256k1 is OFF the hot path (host-side ECDSA via OpenSSL); the batch
+verifier routes mixed valsets by pubkey length — 32 bytes = ed25519 to
+the device, 33 bytes = compressed SEC1 secp256k1 on host."""
 
 from __future__ import annotations
 
@@ -87,3 +93,117 @@ class PrivKey:
     def from_obj(cls, obj) -> "PrivKey":
         assert obj["type"] == "ed25519"
         return cls(bytes.fromhex(obj["value"]))
+
+
+# ---------------------------------------------------------------- secp256k1
+
+def _ec():
+    from cryptography.hazmat.primitives.asymmetric import ec
+    return ec
+
+
+@dataclass(frozen=True)
+class Secp256k1PubKey:
+    """Compressed SEC1 point (33 bytes). Signatures are DER-encoded
+    ECDSA-SHA256 (opaque bytes, like go-crypto's SignatureSecp256k1)."""
+    secp256k1: bytes
+
+    @property
+    def address(self) -> bytes:
+        return address_of(self.secp256k1)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        try:
+            ec = _ec()
+            from cryptography.exceptions import InvalidSignature
+            from cryptography.hazmat.primitives import hashes
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self.secp256k1)
+            pub.verify(sig, msg, ec.ECDSA(hashes.SHA256()))
+            return True
+        except Exception:
+            return False
+
+    def to_obj(self):
+        return {"type": "secp256k1", "value": self.secp256k1.hex()}
+
+    @classmethod
+    def from_obj(cls, obj) -> "Secp256k1PubKey":
+        assert obj["type"] == "secp256k1"
+        return cls(bytes.fromhex(obj["value"]))
+
+
+@dataclass(frozen=True)
+class Secp256k1PrivKey:
+    seed: bytes  # 32-byte big-endian private scalar
+
+    @classmethod
+    def generate(cls, seed: bytes | None = None) -> "Secp256k1PrivKey":
+        if seed is None:
+            seed = os.urandom(32)
+        # clamp into [1, n-1] so any 32-byte seed is a valid key
+        n = int("fffffffffffffffffffffffffffffffebaaedce6af48a03b"
+                "bfd25e8cd0364141", 16)  # secp256k1 group order
+        v = (int.from_bytes(seed, "big") % (n - 1)) + 1
+        return cls(v.to_bytes(32, "big"))
+
+    def _key(self):
+        k = self.__dict__.get("_osslk")
+        if k is None:
+            ec = _ec()
+            k = ec.derive_private_key(int.from_bytes(self.seed, "big"),
+                                      ec.SECP256K1())
+            self.__dict__["_osslk"] = k
+        return k
+
+    @property
+    def pubkey(self) -> Secp256k1PubKey:
+        pk = self.__dict__.get("_pub")
+        if pk is None:
+            from cryptography.hazmat.primitives import serialization
+            pk = Secp256k1PubKey(self._key().public_key().public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.CompressedPoint))
+            self.__dict__["_pub"] = pk
+        return pk
+
+    def sign(self, msg: bytes) -> bytes:
+        ec = _ec()
+        from cryptography.hazmat.primitives import hashes
+        return self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
+
+    def to_obj(self):
+        return {"type": "secp256k1", "value": self.seed.hex()}
+
+    @classmethod
+    def from_obj(cls, obj) -> "Secp256k1PrivKey":
+        assert obj["type"] == "secp256k1"
+        return cls(bytes.fromhex(obj["value"]))
+
+
+def pubkey_from_obj(obj):
+    """Type-dispatching factory (the go-crypto PubKey interface wire
+    format: {type, value})."""
+    if obj["type"] == "ed25519":
+        return PubKey.from_obj(obj)
+    if obj["type"] == "secp256k1":
+        return Secp256k1PubKey.from_obj(obj)
+    raise ValueError(f"unknown pubkey type {obj['type']!r}")
+
+
+def privkey_from_obj(obj):
+    if obj["type"] == "ed25519":
+        return PrivKey.from_obj(obj)
+    if obj["type"] == "secp256k1":
+        return Secp256k1PrivKey.from_obj(obj)
+    raise ValueError(f"unknown privkey type {obj['type']!r}")
+
+
+def verify_any(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """Scalar verify routed by key encoding: 32B = ed25519, 33B (02/03
+    prefix) = compressed secp256k1."""
+    if len(pubkey) == 32:
+        return _ref.verify(pubkey, msg, sig)
+    if len(pubkey) == 33 and pubkey[0] in (2, 3):
+        return Secp256k1PubKey(pubkey).verify(msg, sig)
+    return False

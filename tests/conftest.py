@@ -36,9 +36,43 @@ os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 # pay that. They construct BatchVerifier(mesh=...) directly.
 os.environ.setdefault("TM_TPU_MESH", "off")
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
 import jax  # noqa: E402  (after env setup, before any backend use)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tm_threads():
+    """Leaktest (the reference runs fortytw2/leaktest on its goroutine
+    code, glide.yaml:46-48): no framework-named thread created by a test
+    may outlive it. Catches un-stopped tickers/reactors whose late fires
+    log into torn-down streams — the round-2 'Logging error' class.
+
+    Only tm-* names opt in; the process-wide verify fetch pool
+    (tm-verify-fetch) is deliberately long-lived and excluded."""
+    before = {t.ident for t in threading.enumerate()}
+    # a longer-scoped fixture (module-scoped node) legitimately keeps
+    # respawning its threads (each ticker schedule is a fresh Timer
+    # thread) — a name that was already live before the test is its
+    before_names = {t.name for t in threading.enumerate()}
+
+    def leaked():
+        return [t.name for t in threading.enumerate()
+                if t.ident not in before and t.is_alive()
+                and t.name.startswith("tm-")
+                and t.name not in before_names
+                and not t.name.startswith("tm-verify-fetch")]
+
+    yield
+    deadline = time.monotonic() + 3.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not leaked(), f"leaked framework threads: {leaked()}"
 
 # NOTE: no jax.devices() here — that would pay backend-client creation at
 # collection time for every run, including pure-Python test files.

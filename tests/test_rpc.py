@@ -180,3 +180,135 @@ def test_ws_subscribe_tx_event(rpc_node):
     ev = ws.next_event(timeout=30)
     assert bytes.fromhex(ev["data"]["tx"]) == b"wsevent=1"
     ws.close()
+
+
+def test_http_connection_flood_bounded():
+    """A plain-HTTP connection flood is bounded: over-limit connections
+    get an immediate 503 with NO handler thread spawned, in-limit slow
+    requests all complete, and the server keeps serving afterwards."""
+    import socket as socket_mod
+    import threading as threading_mod
+    import time as time_mod
+
+    from tendermint_tpu.rpc.server import RPCServer
+
+    gate = threading_mod.Event()
+
+    def slow():
+        gate.wait(timeout=10)
+        return {"ok": True}
+
+    srv = RPCServer(max_http_conns=6)
+    srv.register("slow", slow)
+    srv.register("ping", lambda: {"pong": True})
+    host, port = srv.serve("127.0.0.1", 0)
+    try:
+        n_before = threading_mod.active_count()
+        # 6 slow requests occupy every slot
+        socks = []
+        for _ in range(6):
+            s = socket_mod.create_connection((host, port), timeout=10)
+            s.sendall(b"GET /slow HTTP/1.1\r\nHost: x\r\n\r\n")
+            socks.append(s)
+        time_mod.sleep(0.3)
+        # the flood: 30 more connections -> all must be rejected 503
+        rejected = 0
+        for _ in range(30):
+            s = socket_mod.create_connection((host, port), timeout=10)
+            s.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+            line = s.recv(64)
+            if b"503" in line:
+                rejected += 1
+            s.close()
+        assert rejected == 30, f"only {rejected}/30 rejected"
+        # thread growth stayed bounded by the cap (6 handlers + slack)
+        assert threading_mod.active_count() - n_before <= 8, \
+            threading_mod.active_count() - n_before
+        # release the slow handlers: everyone completes
+        gate.set()
+        for s in socks:
+            assert b"200" in s.recv(256)
+            s.close()
+        time_mod.sleep(0.3)
+        # slots freed: normal service resumes
+        s = socket_mod.create_connection((host, port), timeout=10)
+        s.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert b"200" in s.recv(256)
+        s.close()
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_ws_client_auto_reconnects_and_resubscribes():
+    """The reference's auto-reconnecting WSClient (rpc/lib/client/
+    ws_client.go:30-140): kill the server mid-subscription, bring it
+    back on the same port — the client redials with backoff,
+    re-subscribes, and events resume through the same queue. Call
+    latency is tracked."""
+    import threading as th
+    import time as tm
+
+    from tendermint_tpu.rpc.client import ReconnectingWSClient
+    from tendermint_tpu.rpc.server import RPCServer
+
+    def make_server(port):
+        srv = RPCServer()
+
+        def subscribe(query="", ws=None):
+            def pump():
+                i = 0
+                while ws.open:
+                    try:
+                        ws.send_json({"jsonrpc": "2.0", "id": "#event",
+                                      "result": {"q": query, "n": i}})
+                    except ConnectionError:
+                        return
+                    i += 1
+                    tm.sleep(0.05)
+            th.Thread(target=pump, daemon=True).start()
+            return {}
+
+        srv.register("subscribe", subscribe, ws_only=True)
+        srv.register("ping", lambda: {"pong": True})
+        host, p = srv.serve("127.0.0.1", port)
+        return srv, p
+
+    srv, port = make_server(0)
+    c = ReconnectingWSClient("127.0.0.1", port, max_backoff_s=0.5)
+    try:
+        c.subscribe("tm.event = 'X'")
+        ev = c.next_event(timeout=10)
+        assert ev["q"] == "tm.event = 'X'"
+        assert c.call("ping")["pong"] is True
+        assert c.latency["count"] >= 2 and c.latency["max_s"] > 0
+
+        # kill the server mid-subscription
+        srv.stop()
+        deadline = tm.monotonic() + 10
+        while c._client.open and tm.monotonic() < deadline:
+            tm.sleep(0.05)
+        assert not c._client.open, "client never noticed the outage"
+        # calls during the outage fail fast
+        import pytest as _pytest
+        from tendermint_tpu.rpc.client import RPCClientError
+        with _pytest.raises(RPCClientError):
+            c.call("ping")
+
+        # server returns on the SAME port: client must recover alone
+        srv2, _ = make_server(port)
+        try:
+            deadline = tm.monotonic() + 15
+            while c.reconnects == 0 and tm.monotonic() < deadline:
+                tm.sleep(0.05)
+            assert c.reconnects >= 1, "no reconnect within 15s"
+            # the re-subscribed stream flows into the SAME queue
+            while not c.events.empty():
+                c.events.get_nowait()
+            ev = c.next_event(timeout=10)
+            assert ev["q"] == "tm.event = 'X'"
+            assert c.call("ping")["pong"] is True
+        finally:
+            srv2.stop()
+    finally:
+        c.close()

@@ -531,3 +531,83 @@ def test_commit_items_sign_bytes_match_vote_sign_bytes():
     want = [pc.sign_bytes(CHAIN) for pc in commit.precommits
             if pc is not None]
     assert got == want
+
+
+# ----------------------------------------------------------- secp256k1 -----
+
+def test_secp256k1_roundtrip_and_verify():
+    """go-crypto's second key type (exercised by the reference's
+    lite/performance_test.go:10-105): generate, obj round-trip, sign,
+    verify, tamper-reject."""
+    from tendermint_tpu.types.keys import (Secp256k1PrivKey,
+                                           Secp256k1PubKey,
+                                           privkey_from_obj,
+                                           pubkey_from_obj, verify_any)
+
+    k = Secp256k1PrivKey.generate(b"\x07" * 32)
+    pub = k.pubkey
+    assert len(pub.secp256k1) == 33 and pub.secp256k1[0] in (2, 3)
+    assert len(pub.address) == 20
+
+    # deterministic key from seed; obj round-trips through the factory
+    k2 = privkey_from_obj(k.to_obj())
+    assert k2.pubkey == pub
+    assert pubkey_from_obj(pub.to_obj()) == pub
+
+    msg = b"secp message"
+    sig = k.sign(msg)
+    assert pub.verify(msg, sig)
+    assert verify_any(pub.secp256k1, msg, sig)
+    assert not pub.verify(msg + b"x", sig)
+    assert not pub.verify(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    # ed25519 keys still route through verify_any
+    ed = PrivKey.generate(b"\x08" * 32)
+    ed_sig = ed.sign(b"m")
+    assert verify_any(ed.pubkey.ed25519, b"m", ed_sig)
+
+
+def test_mixed_keytype_valset_commit():
+    """A validator set mixing ed25519 and secp256k1 members verifies a
+    commit through BOTH verifier backends: ed25519 signatures batch to
+    the device kernel, secp256k1 ones verify on host, verdicts merge."""
+    from tendermint_tpu.types.keys import Secp256k1PrivKey
+
+    ed_keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(3)]
+    secp_keys = [Secp256k1PrivKey.generate(bytes([i + 0x40]) * 32)
+                 for i in range(2)]
+    vals = [Validator(k.pubkey.ed25519, 10) for k in ed_keys] + \
+           [Validator(k.pubkey.secp256k1, 10) for k in secp_keys]
+    vs = ValidatorSet(vals)
+    by_addr = {}
+    for k in ed_keys + secp_keys:
+        by_addr[k.pubkey.address] = k
+
+    bid = make_block_id()
+    precommits = []
+    for idx, val in enumerate(vs.validators):
+        k = by_addr[val.address]
+        v = Vote(validator_address=val.address, validator_index=idx,
+                 height=9, round=0, timestamp_ns=2000 + idx,
+                 type=VoteType.PRECOMMIT, block_id=bid)
+        v.signature = k.sign(v.sign_bytes(CHAIN))
+        precommits.append(v)
+    commit = Commit(block_id=bid, precommits=precommits)
+
+    for backend in ("python", "jax"):
+        vs.verify_commit(CHAIN, bid, 9, commit,
+                         verifier=BatchVerifier(backend))
+
+    # tamper one secp signature and one ed signature: each must fail
+    for idx, val in enumerate(vs.validators):
+        if len(val.pubkey) == 33:
+            break
+    bad = Commit(block_id=bid, precommits=list(precommits))
+    sig = bad.precommits[idx].signature
+    bad.precommits[idx] = Vote(
+        validator_address=bad.precommits[idx].validator_address,
+        validator_index=idx, height=9, round=0,
+        timestamp_ns=2000 + idx, type=VoteType.PRECOMMIT, block_id=bid,
+        signature=sig[:-1] + bytes([sig[-1] ^ 1]))
+    with pytest.raises(ValueError):
+        vs.verify_commit(CHAIN, bid, 9, bad,
+                         verifier=BatchVerifier("jax"))
