@@ -339,7 +339,16 @@ def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
     an_scratch[3] = _mul_t(xn, y)
     a_neg = tuple(an_scratch[c] for c in range(4))
 
-    # build tables
+    _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
+                 s_table_ref, d2, out_ref)
+
+
+def _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
+                 s_table_ref, d2, out_ref):
+    """Everything after decompression — table build, the Straus-w4
+    ladder, affine conversion, encode, R compare — shared by the full
+    and predecompressed kernels (inlined at trace time; one definition
+    keeps the two paths from diverging)."""
     h_table = [_pt_identity(bsz), a_neg]
     for k in range(2, 16):
         h_table.append(_pt_double(h_table[k // 2]) if k % 2 == 0
@@ -428,6 +437,71 @@ def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
 # ---------------------------------------------------------------------------
 # Host-precomputed tables + digit packing
 # ---------------------------------------------------------------------------
+
+def _verify_kernel_pre(xnb_ref, yb_ref, okp_ref, rb_ref, dig_s_ref,
+                       dig_h_ref, s_table_ref, d2_ref, out_ref,
+                       an_scratch):
+    """Predecompressed variant of _verify_kernel: A's decompression
+    (the sqrt-ratio exponentiation, ~20% of the fused kernel) was done
+    ONCE per validator set and cached; the kernel receives (-A) as
+    canonical x/y byte strings plus the validity mask. Everything after
+    the decompress block is identical to _verify_kernel."""
+    bsz = xnb_ref.shape[-1]
+    d2 = jnp.broadcast_to(d2_ref[:][:, None], (NLIMBS, bsz))
+    xn, _sx = _from_bytes_t(xnb_ref[:])   # canonical: sign bits are 0
+    y, _sy = _from_bytes_t(yb_ref[:])
+    ok = okp_ref[0, :] != 0
+    one = _one_t(bsz)
+    an_scratch[0] = xn
+    an_scratch[1] = y
+    an_scratch[2] = one
+    an_scratch[3] = _mul_t(xn, y)
+    a_neg = tuple(an_scratch[c] for c in range(4))
+
+    _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
+                 s_table_ref, d2, out_ref)
+
+
+def verify_pallas_pre(xn_bytes, y_bytes, ok, rb_u8, s_bits, h_bits,
+                      tile: int = DEFAULT_TILE, interpret: bool = False):
+    """verify_pallas with (-A) pre-decompressed: xn_bytes/y_bytes are
+    the canonical field-element encodings of -A.x and A.y (uint8[N,32]),
+    ok the decompression validity mask."""
+    n = xn_bytes.shape[0]
+    tile = min(tile, n)
+    assert n % tile == 0, (n, tile)
+
+    xnb_t = xn_bytes.astype(jnp.int32).T
+    yb_t = y_bytes.astype(jnp.int32).T
+    okp = ok.astype(jnp.int32)[None, :]
+    rb_t = rb_u8.astype(jnp.int32).T
+    dig_s = _digits4_t(s_bits)
+    dig_h = _digits4_t(h_bits)
+
+    out = pl.pallas_call(
+        _verify_kernel_pre,
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(n // tile,),
+            in_specs=[
+                pl.BlockSpec((32, tile), lambda i: (0, i)),
+                pl.BlockSpec((32, tile), lambda i: (0, i)),
+                pl.BlockSpec((1, tile), lambda i: (0, i)),
+                pl.BlockSpec((32, tile), lambda i: (0, i)),
+                pl.BlockSpec((64, tile), lambda i: (0, i)),
+                pl.BlockSpec((64, tile), lambda i: (0, i)),
+                pl.BlockSpec((16, 4, NLIMBS), lambda i: (0, 0, 0)),
+                pl.BlockSpec((NLIMBS,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+            scratch_shapes=[pltpu.VMEM((4, NLIMBS, tile), jnp.int32)],
+        ),
+        interpret=interpret,
+    )(xnb_t, yb_t, okp, rb_t, dig_s, dig_h, jnp.asarray(_s_table_np()),
+      jnp.asarray(fe.D2))
+    return out[0].astype(jnp.bool_)
+
 
 @functools.lru_cache(maxsize=None)
 def _s_table_np():

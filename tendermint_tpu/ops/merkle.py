@@ -26,11 +26,13 @@ import functools
 import hashlib
 import struct
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from tendermint_tpu.ops import sha256
+# jax (and ops.sha256, which pulls it in) is imported LAZILY inside the
+# device functions: merkle is imported by the core data model
+# (types/block.py), and a plain CPU node — every e2e/crash-matrix
+# subprocess — must not pay the multi-second jax import for host-side
+# hashing it never uses.
 
 EMPTY_DIGEST = b"\x00" * 32  # padding leaf
 
@@ -130,22 +132,42 @@ _PREFIX_NODE = np.array([0x01], dtype=np.uint8)
 
 def leaf_hash_device(items):
     """uint8[..., N, L] -> uint8[..., N, 32] (static item length L)."""
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import sha256
     pre = jnp.broadcast_to(jnp.asarray(_PREFIX_LEAF), items.shape[:-1] + (1,))
     return sha256.hash_fixed(jnp.concatenate([pre, items], axis=-1))
 
 
 def _level_up(digests):
     """uint8[..., M, 32] -> uint8[..., M//2, 32]: one batched tree level."""
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import sha256
     m = digests.shape[-2]
     pairs = digests.reshape(digests.shape[:-2] + (m // 2, 64))
     pre = jnp.broadcast_to(jnp.asarray(_PREFIX_NODE), pairs.shape[:-1] + (1,))
     return sha256.hash_fixed(jnp.concatenate([pre, pairs], axis=-1))
 
 
-@functools.partial(jax.jit, static_argnames=("n_leaves",))
+_root_from_digests_jit = None
+
+
 def root_from_digests(digests, n_leaves: int):
     """Device Merkle root: digests uint8[padded, 32] (already padded to a
     power of two with zero rows beyond n_leaves) -> uint8[32]."""
+    global _root_from_digests_jit
+    if _root_from_digests_jit is None:
+        import jax
+        _root_from_digests_jit = functools.partial(
+            jax.jit, static_argnames=("n_leaves",))(_root_from_digests)
+    return _root_from_digests_jit(digests, n_leaves=n_leaves)
+
+
+def _root_from_digests(digests, n_leaves: int):
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import sha256
     level = digests
     while level.shape[-2] > 1:
         level = _level_up(level)
@@ -173,6 +195,7 @@ def root(items: list[bytes]) -> bytes:
     n = len(items)
     if n == 0:
         return _final_hash(0, EMPTY_DIGEST)
+    import jax.numpy as jnp
     digests = np.stack(
         [np.frombuffer(leaf_hash(it), np.uint8) for it in items])
     out = root_from_digests(jnp.asarray(pad_digests(digests)), n)

@@ -319,8 +319,13 @@ class ReconnectingWSClient:
         return self.events.get(timeout=timeout)
 
     def close(self) -> None:
-        self.open = False
-        c = self._client
+        # under the lock: _connect() checks self.open and installs the
+        # new client inside the same lock, so close() can never
+        # interleave between that check and the install (which would
+        # leak a live connection)
+        with self._lock:
+            self.open = False
+            c = self._client
         if c is not None:
             c.close()
 

@@ -103,3 +103,40 @@ def test_verify_batch_padding_and_empty():
     sigs = [ref.sign(s, m) for s, m in zip(sds, msgs)]
     got = ed25519.verify_batch(pks, msgs, sigs)
     assert got.all() and got.shape == (3,)
+
+
+def test_predecompressed_cache_path_matches_full():
+    """The stable-valset fast path (pre-decompressed pubkey cache,
+    ops/ed25519._verify_cached_predecomp): the first occurrence of a
+    pubkey batch takes the full kernel, repeats take the *_pre kernel
+    with cached (-A) bytes — verdicts must be identical across calls,
+    including invalid pubkeys and tampered signatures."""
+    import random
+
+    from tendermint_tpu.ops import ed25519
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    rng = random.Random(99)
+    n = 64
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = rng.randbytes(32)
+        m = b"pre-cache %d" % i
+        pubs.append(ref.public_key(seed))
+        msgs.append(m)
+        sigs.append(ref.sign(seed, m))
+    # sprinkle failures: tampered sig, wrong msg, non-point pubkey
+    sigs[5] = sigs[5][:32] + bytes([sigs[5][32] ^ 1]) + sigs[5][33:]
+    msgs[11] = b"wrong"
+    pubs[17] = b"\xff" * 32
+
+    expect = [i not in (5, 11, 17) for i in range(n)]
+    ed25519._predecomp.clear()
+    ed25519._predecomp_seen.clear()
+    r1 = ed25519.verify_batch(pubs, msgs, sigs)   # full kernel, records
+    assert r1.tolist() == expect
+    r2 = ed25519.verify_batch(pubs, msgs, sigs)   # builds + uses cache
+    assert r2.tolist() == expect
+    assert len(ed25519._predecomp) == 1, "cache did not engage"
+    r3 = ed25519.verify_batch(pubs, msgs, sigs)   # cache hit
+    assert r3.tolist() == expect
