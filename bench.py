@@ -225,9 +225,7 @@ def main() -> int:
         try:
             import bench_lite
             extra["lite"] = bench_lite.run(2000, 64)
-            # 8 vals: headers/sec is host-per-header-bound at this
-            # valcount either way, and build time halves vs 16
-            extra["lite_100k"] = bench_lite.run_large(100_000, 8)
+            extra["lite_100k"] = bench_lite.run_large(100_000, 16)
         except Exception as e:  # pragma: no cover
             extra["lite_error"] = repr(e)
         try:

@@ -63,15 +63,19 @@ def test_sharded_verify_matches_unsharded():
         assert got[i] == (i not in tamper), i
 
 
-def test_sharded_verify_on_smaller_mesh():
-    # 2-device mesh from the same 8 virtual devices
+def test_sharded_kernel_on_smaller_mesh():
+    """make_mesh(n < all devices) shards correctly. Exercised through
+    the MERKLE kernel: the 2-device ed25519 SPMD program costs a ~40s
+    extra compile for no additional coverage (the verify kernel's
+    sharding is already proven on the 8-device mesh above; mesh-width
+    partitioning is kernel-agnostic in shard_map)."""
     mesh = make_mesh(2)
-    pubs, msgs, sigs = signed_batch(4, tamper={2})
-    pk, rb, sbits, hbits, _ = ed25519.prepare_batch(pubs, msgs, sigs)
-    got = np.asarray(sharded_verify_kernel(mesh)(
-        jnp.asarray(pk), jnp.asarray(rb),
-        jnp.asarray(sbits), jnp.asarray(hbits)))
-    assert got.tolist() == [True, True, False, True]
+    items = [bytes([i]) * 9 for i in range(16)]
+    digests = merkle.pad_digests(np.stack(
+        [np.frombuffer(merkle.leaf_hash(it), np.uint8) for it in items]))
+    got = np.asarray(sharded_merkle_root(mesh)(
+        jnp.asarray(digests), len(items))).tobytes()
+    assert got == merkle.root_host(items)
 
 
 @pytest.mark.parametrize("n_leaves", [8, 9, 13, 16, 100, 128])
