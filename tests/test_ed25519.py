@@ -140,3 +140,68 @@ def test_predecompressed_cache_path_matches_full():
     assert len(ed25519._predecomp) == 1, "cache did not engage"
     r3 = ed25519.verify_batch(pubs, msgs, sigs)   # cache hit
     assert r3.tolist() == expect
+
+
+def test_scalar_openssl_matches_pure_oracle():
+    """PubKey.verify/verify_any route through OpenSSL (~170x faster);
+    verdicts must agree with the pure RFC 8032 oracle on valid,
+    tampered, truncated, garbage AND adversarial non-canonical
+    encodings (OpenSSL's leniency gap routes back to the oracle — a
+    verdict split there would be a consensus fork)."""
+    import random
+
+    import pytest as _pytest
+
+    from tendermint_tpu.types import keys as keys_mod
+    from tendermint_tpu.types.keys import PubKey, _openssl_verify
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    _pytest.importorskip("cryptography")
+
+    p255 = (1 << 255) - 19
+    rng = random.Random(4242)
+    for i in range(30):
+        seed = rng.randbytes(32)
+        pk = ref.public_key(seed)
+        msg = rng.randbytes(rng.randrange(0, 64))
+        sig = ref.sign(seed, msg)
+        cases = [
+            (pk, msg, sig),                                   # valid
+            (pk, msg + b"x", sig),                            # wrong msg
+            (pk, msg, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]),
+            (pk, msg, sig[:-1]),                              # short sig
+            (pk, msg, rng.randbytes(64)),                     # garbage
+            (rng.randbytes(32), msg, sig),                    # wrong key
+        ]
+        for p, m, s in cases:
+            want = ref.verify(p, m, s)
+            assert PubKey(p).verify(m, s) == want, (i, p.hex())
+
+    # adversarial non-canonical encodings: x=0 identity rows with the
+    # sign bit set, and y >= p — _openssl_verify must DECLINE (None)
+    # and the routed verdict must equal the oracle's
+    msg = b"adversarial"
+    ncid = (1).to_bytes(32, "little")
+    ncid = ncid[:31] + bytes([ncid[31] | 0x80])        # y=1, sign=1
+    ncid2 = (p255 - 1).to_bytes(32, "little")
+    ncid2 = ncid2[:31] + bytes([ncid2[31] | 0x80])     # y=-1, sign=1
+    ybig = (p255 + 2).to_bytes(32, "little")           # y >= p
+    for bad in (ncid, ncid2, ybig):
+        for pkey, sg in ((bad, bad + bytes(32)),
+                         (ref.public_key(b"\x01" * 32), bad + bytes(32)),
+                         (bad, ref.sign(b"\x01" * 32, msg))):
+            assert _openssl_verify(pkey, msg, sg) is None, bad.hex()
+            assert PubKey(pkey).verify(msg, sg) == \
+                ref.verify(pkey, msg, sg)
+
+    # the pure-fallback branch (no cryptography) still verifies
+    orig = keys_mod._ossl_pub_cls
+    try:
+        keys_mod._ossl_pub_cls = False
+        seed = b"\x05" * 32
+        pk = ref.public_key(seed)
+        sig = ref.sign(seed, msg)
+        assert PubKey(pk).verify(msg, sig)
+        assert not PubKey(pk).verify(msg + b"!", sig)
+    finally:
+        keys_mod._ossl_pub_cls = orig
