@@ -303,3 +303,64 @@ def test_heartbeat_sent_while_waiting_for_txs():
     cs.submit({"type": "txs_available"})
     run_until_height([cs], 3)
     assert cs.state.last_block_height >= 3
+
+
+def test_bad_proposal_rejected_and_prevotes_nil():
+    """TestStateBadProposal (consensus/state_test.go:182): a proposal
+    with a forged signature never becomes the round's proposal, and a
+    properly-signed proposal for an INVALID block (bad app_hash) makes
+    the node prevote nil — never the bad block's hash."""
+    from tendermint_tpu.types.block import Commit
+    from tendermint_tpu.types.proposal import Proposal
+
+    nodes, keys = make_net(2, chain_id="badprop-test")
+    # identify the height-1 proposer; the OTHER node is under test,
+    # ISOLATED (no relays) so only the hand-crafted messages arrive
+    for n in nodes:
+        n.broadcast_hooks.clear()
+    prop_addr = nodes[0].rs.validators.proposer().address
+    prop_idx = next(i for i, k in enumerate(keys)
+                    if k.pubkey.address == prop_addr)
+    victim = nodes[1 - prop_idx]
+    prop_key = keys[prop_idx]
+    victim.start()
+
+    # build an invalid block: proper structure, corrupted app_hash
+    bad_block = victim.state.make_block(
+        1, [b"tx=1"], Commit(), time_ns=10 ** 9)
+    bad_block.header.app_hash = b"\xde\xad" * 16
+    parts = bad_block.make_part_set(
+        victim.state.consensus_params.block_gossip.block_part_size_bytes)
+
+    # 1) forged signature: rejected, no proposal recorded
+    forged = Proposal(1, 0, parts.header(), timestamp_ns=5)
+    forged.signature = keys[1 - prop_idx].sign(   # WRONG signer
+        forged.sign_bytes("badprop-test"))
+    victim.submit({"type": "proposal", "proposal": forged.to_obj()},
+                  peer_id="peerX")
+    assert victim.rs.proposal is None, "forged proposal accepted"
+
+    # 2) properly-signed proposal for the invalid block: accepted as
+    # the round's proposal, but the prevote must be NIL
+    prevotes = []
+    victim.broadcast_hooks.append(
+        lambda m: prevotes.append(m) if m.get("type") == "vote" and
+        m["vote"]["type"] == 1 else None)
+    good_sig = Proposal(1, 0, parts.header(), timestamp_ns=5)
+    good_sig.signature = prop_key.sign(good_sig.sign_bytes("badprop-test"))
+    victim.submit({"type": "proposal", "proposal": good_sig.to_obj()},
+                  peer_id="peerX")
+    assert victim.rs.proposal is not None
+    for i in range(parts.total):
+        victim.submit({"type": "block_part", "height": 1, "round": 0,
+                       "part": parts.get_part(i).to_obj()},
+                      peer_id="peerX")
+    # drive timeouts until the prevote goes out
+    for _ in range(20):
+        if prevotes:
+            break
+        victim.ticker.fire_next()
+    assert prevotes, "no prevote broadcast"
+    v = prevotes[0]["vote"]
+    assert v["block_id"]["hash"] == "", \
+        f"prevoted the invalid block: {v['block_id']}"
