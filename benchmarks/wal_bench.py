@@ -1,0 +1,79 @@
+"""WAL codec benchmark — mirrors the reference's WAL decode benchmarks
+(consensus/wal_test.go:111-130: BenchmarkWalDecode for message sizes
+512 B through 1 MB).
+
+Measures encode and decode throughput of the CRC32c-framed canonical
+JSON WAL format (storage/wal.py) across payload sizes, plus the
+corruption-detection path (a flipped byte must be caught by the CRC).
+
+Standalone: `python benchmarks/wal_bench.py` prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.storage.wal import (  # noqa: E402
+    WALCorruptionError, WALMessage, decode_frames, encode_frame,
+)
+
+
+def bench_size(payload_bytes: int, budget_s: float = 1.0) -> dict:
+    msg = WALMessage(time_ns=123456789,
+                     msg={"type": "block_part", "height": 42,
+                          "part": {"payload": ("ab" * (payload_bytes // 2))}})
+    frame = encode_frame(msg)
+
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s / 2:
+        encode_frame(msg)
+        n += 1
+    enc_rate = n / (time.perf_counter() - t0)
+
+    blob = frame * 64
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s / 2:
+        msgs = list(decode_frames(blob))
+        assert len(msgs) == 64
+        n += 64
+    dec_rate = n / (time.perf_counter() - t0)
+
+    # corruption detection: one flipped payload byte -> CRC failure
+    corrupt = bytearray(frame)
+    corrupt[len(corrupt) // 2] ^= 0x01
+    try:
+        list(decode_frames(bytes(corrupt), tolerate_truncated_tail=False))
+        raise AssertionError("corruption not detected")
+    except WALCorruptionError:
+        pass
+
+    return {
+        "payload_bytes": payload_bytes,
+        "frame_bytes": len(frame),
+        "encode_per_sec": round(enc_rate, 1),
+        "decode_per_sec": round(dec_rate, 1),
+        "decode_mb_per_sec": round(dec_rate * len(frame) / 1e6, 1),
+    }
+
+
+def main() -> int:
+    sizes = [512, 4096, 65536, 1 << 20]
+    rows = [bench_size(s) for s in sizes]
+    print(json.dumps({
+        "metric": "wal_codec",
+        "value": rows[0]["decode_per_sec"],
+        "unit": "512B-frames decoded/sec",
+        "extra": {"sizes": rows},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
