@@ -142,7 +142,7 @@ class InquiringCertifier:
 
 def certify_chain(chain_id: str, fcs: List[FullCommit],
                   trusted: Optional[ValidatorSet] = None,
-                  verifier=None, window: int = 512) -> None:
+                  verifier=None, window: Optional[int] = None) -> None:
     """Certify consecutive FullCommits with pooled, PIPELINED signature
     batches — the 1M-header lite-chain workload (BASELINE.json config 5)
     instead of per-header VerifyCommit loops (lite/performance_test.go's
@@ -166,6 +166,11 @@ def certify_chain(chain_id: str, fcs: List[FullCommit],
     if not fcs:
         return
     expect_vals = trusted or fcs[0].validators
+    if window is None:
+        # sweeps at 16 and 64 validators both peak near ~32k signatures
+        # in flight per window (tunnel round trips amortized, chunks
+        # fetched in parallel, memory still bounded)
+        window = max(64, 32768 // max(1, len(expect_vals)))
 
     def collect(window_fcs):
         items_w = []

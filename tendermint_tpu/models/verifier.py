@@ -99,9 +99,15 @@ def _parse_mesh_spec(mesh: str) -> str | int:
 
 
 class BatchVerifier:
-    def __init__(self, backend: str = "auto", auto_threshold: int = 4,
+    def __init__(self, backend: str = "auto", auto_threshold: int = 128,
                  kernel: Callable | None = None, mesh: str = "off",
                  min_bucket: int = 8):
+        # auto_threshold: batches at or below this verify scalar on host.
+        # OpenSSL does ~30us/sig, so a 64-validator commit costs ~2ms
+        # scalar — while a device dispatch is a few ms even on a locally
+        # attached chip (and ~100ms over a tunnel). Breakeven sits near
+        # 100-150 sigs; bulk paths (fast-sync windows, lite chains,
+        # 1000+-validator commits) are far above it either way.
         # eager, loud validation — this is fed by config/env text, and a
         # typo must fail at startup (asserts vanish under python -O)
         if backend not in ("auto", "jax", "python"):
