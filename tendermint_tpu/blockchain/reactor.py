@@ -30,11 +30,13 @@ BLOCKCHAIN_CHANNEL = 0x40
 SYNC_TICK_S = 0.05                # trySyncTicker (blockchain/reactor.go)
 STATUS_UPDATE_INTERVAL_S = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
-VERIFY_WINDOW = 128               # blocks batched per device dispatch;
-#                                   on tunneled TPU links the per-dispatch
-#                                   round trip dominates below ~8k sigs,
-#                                   so bigger windows sync measurably
-#                                   faster (sweep: 64→250, 128→390 bl/s)
+VERIFY_WINDOW = 256               # blocks batched per device dispatch:
+#                                   the sweep optimum (~16-32k sigs in
+#                                   flight at 64 validators) — dispatch
+#                                   round trips amortize and the window
+#                                   only ever drains what the pool has,
+#                                   so the cap is free when fewer blocks
+#                                   are downloaded
 
 
 class BlockchainReactor(Reactor):
