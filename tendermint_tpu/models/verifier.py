@@ -99,15 +99,20 @@ def _parse_mesh_spec(mesh: str) -> str | int:
 
 
 class BatchVerifier:
-    def __init__(self, backend: str = "auto", auto_threshold: int = 128,
+    def __init__(self, backend: str = "auto", auto_threshold: int = None,
                  kernel: Callable | None = None, mesh: str = "off",
                  min_bucket: int = 8):
-        # auto_threshold: batches at or below this verify scalar on host.
-        # OpenSSL does ~30us/sig, so a 64-validator commit costs ~2ms
-        # scalar — while a device dispatch is a few ms even on a locally
-        # attached chip (and ~100ms over a tunnel). Breakeven sits near
-        # 100-150 sigs; bulk paths (fast-sync windows, lite chains,
-        # 1000+-validator commits) are far above it either way.
+        # auto_threshold: batches at or below this verify scalar on host
+        # (OpenSSL, ~130us/sig). The scalar/batch breakeven depends on
+        # the dispatch round trip: ~30-50 sigs on a locally-attached
+        # chip (~3-5ms), ~500+ over a tunneled link (~60-100ms). The
+        # default of 128 keeps small interactive commits off the
+        # dispatch latency everywhere; deployments tune it with
+        # TM_TPU_AUTO_THRESHOLD. Bulk paths (fast-sync windows, lite
+        # chains, 1000+-validator commits) sit far above any setting.
+        if auto_threshold is None:
+            auto_threshold = int(os.environ.get(
+                "TM_TPU_AUTO_THRESHOLD", "128"))
         # eager, loud validation — this is fed by config/env text, and a
         # typo must fail at startup (asserts vanish under python -O)
         if backend not in ("auto", "jax", "python"):
