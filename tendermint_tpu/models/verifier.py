@@ -12,12 +12,15 @@ Backends:
   "jax"    — ops/ed25519.py batch kernel; the one TPU chip XLA targets, or
              CPU XLA when no TPU is present. Chunked to BATCH_CHUNK to stay
              in VMEM (large monolithic batches fall off a perf cliff).
-  "python" — pure-Python RFC 8032 loop (utils/ed25519_ref.py); the
-             bit-exact oracle, also the fastest choice for N <= ~4 on hosts
-             where jit dispatch overhead dominates.
-  "auto"   — python below a size threshold, jax above (the dual-path split
-             SURVEY.md §7 calls for: scalar for interactive single votes,
-             batch for commits/fast-sync/lite).
+  "python" — scalar host loop, routed by key type through
+             types/keys.verify_any (OpenSSL ed25519 with the pure
+             RFC 8032 oracle as fallback and for OpenSSL's
+             leniency-gap encodings; secp256k1 via ECDSA).
+  "auto"   — scalar at or below auto_threshold (default 128, env
+             TM_TPU_AUTO_THRESHOLD), batch above: the dual-path split
+             SURVEY.md §7 calls for — interactive votes and small
+             commits stay off the dispatch round trip, bulk paths
+             (fast-sync windows, lite chains, large commits) batch.
 
 Multi-chip: `mesh="auto"` (the default via TM_TPU_MESH / config
 `base.verifier_mesh`) makes the verifier shard its batches over every
