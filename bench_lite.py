@@ -106,9 +106,13 @@ def run(n_headers: int = 2000, n_vals: int = 64,
     from tendermint_tpu.models.verifier import default_verifier
     default_verifier().warmup(n_headers * n_vals)
 
-    t0 = time.perf_counter()
-    certify_chain(chain_id, fcs, trusted=valset)
-    dt = time.perf_counter() - t0
+    # best-of-3: shared-tunnel load varies minute to minute (same
+    # policy as the headline and fast-sync arms)
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        certify_chain(chain_id, fcs, trusted=valset)
+        dt = min(dt, time.perf_counter() - t0)
     rate = n_headers / dt
 
     out = {
