@@ -192,6 +192,26 @@ class BatchVerifier:
             out1 = np.array([verify_any(p, m, s) for p, m, s in items],
                             np.bool_)
             return lambda: out1
+        # fast path: the whole host prep (classification, length/s<L
+        # checks, SHA-512 + mod-L) in one native call, GIL released —
+        # returns None for batches that need the general path below
+        # (secp256k1 keys, non-bytes members, native unavailable)
+        from tendermint_tpu import native
+        prep = native.prep_items(items)
+        if prep is not None:
+            from tendermint_tpu.ops import ed25519
+            if not self._mesh_resolved:
+                self._resolve_mesh()
+            self.stats["jax_sigs"] += n
+            pk, rb, sb, hb, pre = prep
+            pending = []
+            for lo in range(0, n, BATCH_CHUNK):
+                hi = min(lo + BATCH_CHUNK, n)
+                res = ed25519.verify_prepared_async(
+                    pk[lo:hi], rb[lo:hi], sb[lo:hi], hb[lo:hi],
+                    kernel=self.kernel, min_bucket=self._min_bucket)
+                pending.append((lo, hi, res, pre[lo:hi]))
+            return self._make_resolver(n, pending)
         # mixed-key routing: 33-byte compressed-SEC1 pubkeys are
         # secp256k1 — verified on host (off the TPU hot path by design,
         # types/keys.py); everything else goes to the ed25519 device
@@ -239,7 +259,10 @@ class BatchVerifier:
                 pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel,
                 min_bucket=self._min_bucket)
             pending.append((lo, hi, res, pre))
+        return self._make_resolver(n, pending)
 
+    @staticmethod
+    def _make_resolver(n: int, pending):
         def resolve() -> np.ndarray:
             out = np.zeros(n, np.bool_)
             if len(pending) > 1:
@@ -264,7 +287,9 @@ class BatchVerifier:
         stays here, next to the code that defines it."""
         if n_sigs <= 0 or self.backend == "python":
             return  # scalar backend compiles nothing
+        from tendermint_tpu import native
         from tendermint_tpu.ops import ed25519
+        native.prep_items([])  # force the prep-extension g++ build now
         shapes = {min(BATCH_CHUNK, n_sigs)}
         tail = n_sigs % BATCH_CHUNK
         if n_sigs > BATCH_CHUNK and tail:

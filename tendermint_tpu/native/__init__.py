@@ -85,30 +85,6 @@ _codec_mod = None
 _codec_tried = False
 
 
-def _build_codec() -> Optional[str]:
-    try:
-        if os.path.exists(_CODEC_LIB) and \
-                os.path.getmtime(_CODEC_LIB) >= os.path.getmtime(_CODEC_SRC):
-            return _CODEC_LIB
-    except OSError:
-        # stale .so next to a missing source: use the built lib rather
-        # than crash — every failure here must fall back, never raise
-        return _CODEC_LIB if os.path.exists(_CODEC_LIB) else None
-    import sysconfig
-    inc = sysconfig.get_paths().get("include")
-    if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
-        return None
-    tmp = _CODEC_LIB + f".{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           f"-I{inc}", _CODEC_SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        return None
-    os.replace(tmp, _CODEC_LIB)
-    return _CODEC_LIB
-
-
 def codec():
     """The _tmcodec extension module, or None when unavailable.
     Exposes canonical_dumps(obj)->bytes and the Fallback exception."""
@@ -119,18 +95,95 @@ def codec():
         _codec_tried = True
         if os.environ.get("TM_TPU_NO_NATIVE"):
             return None
-        path = _build_codec()
-        if path is None:
-            return None
-        try:
-            import importlib.util
-            spec = importlib.util.spec_from_file_location("_tmcodec", path)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-        except Exception:
-            return None
-        _codec_mod = mod
+        _codec_mod = _load_ext("_tmcodec", _CODEC_SRC, _CODEC_LIB)
         return _codec_mod
+
+
+# -- batched Ed25519 verify-prep extension (prep.cpp) -----------------------
+# CPython extension like the codec: takes the verifier's items list and
+# returns the device-bound arrays in one call (GIL released for the
+# SHA-512 loop). Falls back to None -> callers use the Python path.
+
+_PREP_SRC = os.path.join(_HERE, "prep.cpp")
+_PREP_LIB = os.path.join(_HERE, "_tmprep.so")
+_prep_mod = None
+_prep_tried = False
+
+
+def _build_ext(src: str, lib: str, opt: str = "-O2",
+               extra_deps: tuple = ()) -> Optional[str]:
+    """Build a CPython extension .so from src, cached next to it.
+    extra_deps: sources the src #includes, for staleness checking."""
+    try:
+        deps = (src,) + tuple(extra_deps)
+        if os.path.exists(lib) and all(
+                os.path.getmtime(lib) >= os.path.getmtime(d) for d in deps):
+            return lib
+    except OSError:
+        return lib if os.path.exists(lib) else None
+    import sysconfig
+    inc = sysconfig.get_paths().get("include")
+    if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+        return None
+    tmp = lib + f".{os.getpid()}.tmp"
+    cmd = ["g++", opt, "-shared", "-fPIC", "-std=c++17",
+           f"-I{inc}", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(tmp, lib)
+    return lib
+
+
+def _load_ext(modname: str, src: str, lib: str, opt: str = "-O2",
+              extra_deps: tuple = ()):
+    """Build (if stale) and import a CPython extension; None on any
+    failure — callers fall back to pure Python."""
+    path = _build_ext(src, lib, opt, extra_deps)
+    if path is None:
+        return None
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(modname, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    return mod
+
+
+def _prep():
+    global _prep_mod, _prep_tried
+    with _lock:
+        if _prep_tried:
+            return _prep_mod
+        _prep_tried = True
+        if os.environ.get("TM_TPU_NO_NATIVE"):
+            return None
+        # prep.cpp #includes hostops.cpp, so it depends on both sources
+        _prep_mod = _load_ext("_tmprep", _PREP_SRC, _PREP_LIB, "-O3",
+                              extra_deps=(_SRC,))
+        return _prep_mod
+
+
+def prep_items(items):
+    """One-call verify prep: items [(pk, msg, sig), ...] ->
+    (pk u8[N,32], R u8[N,32], s u8[N,32], h u8[N,32], pre bool[N])
+    numpy views, or None when unavailable / when the batch needs the
+    general path (secp256k1 keys, non-bytes members)."""
+    mod = _prep()
+    if mod is None:
+        return None
+    out = mod.prep_items(items)
+    if out is None:
+        return None
+    import numpy as np
+    n = len(items)
+    pk_b, rb_b, s_b, h_b, pre_b = out
+    as_mat = lambda b: np.frombuffer(b, np.uint8).reshape(n, 32)
+    pre = np.frombuffer(pre_b, np.uint8).astype(bool)
+    return as_mat(pk_b), as_mat(rb_b), as_mat(s_b), as_mat(h_b), pre
 
 
 def _pack(items: List[bytes]):

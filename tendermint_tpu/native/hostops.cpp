@@ -437,23 +437,23 @@ static const uint64_t L_LIMBS[5] = {0x5812631a5cf5d3edULL,
                                     0x14def9dea2f79cd6ULL, 0ULL,
                                     0x1000000000000000ULL, 0ULL};
 
-// acc (5 limbs, < 2^253-ish) = acc * 256 + byte, then reduce below L:
-// q = acc >> 252 (< 2^9), acc -= q*L; the remainder may be negative by
-// < q*c < 2^134, so at most one add-back of L restores the range.
+// acc (5 limbs, < 2^253-ish) = acc * 2^48 + chunk, then reduce below L:
+// q = acc >> 252, acc -= q*L; the remainder may be negative by
+// < q*c, so at most one add-back of L restores the range.
 struct Acc320 {
   uint64_t v[5] = {0, 0, 0, 0, 0};
 
-  void push_u32(uint32_t b) {
-    // multiply by 2^32: shift left across limbs (acc < L < 2^253, so
-    // the result fits 285 bits < 320)
+  void push_u48(uint64_t b) {
+    // multiply by 2^48: shift left across limbs (acc < 2^253 after the
+    // previous reduce, so the result fits 301 bits < 320)
     uint64_t carry = b;
     for (int i = 0; i < 5; i++) {
-      unsigned __int128 t = ((unsigned __int128)v[i] << 32) | carry;
+      unsigned __int128 t = ((unsigned __int128)v[i] << 48) | carry;
       v[i] = (uint64_t)t;
       carry = (uint64_t)(t >> 64);
     }
-    // reduce: q = bits above 252 (< 2^33; q*L limb products fit u128,
-    // and the post-subtract deficit is < q*c < 2^158 << L, so one
+    // reduce: q = bits above 252 (< 2^49; q*L limb products fit u128,
+    // and the post-subtract deficit is < q*c < 2^174 << L, so one
     // add-back still restores the range)
     uint64_t q = v[3] >> 60 | (v[4] << 4);  // acc >> 252, fits well in 64
     if (q) {
@@ -502,15 +502,16 @@ struct Acc320 {
   }
 };
 
-// digest (64 bytes little-endian integer) mod L -> 32 bytes little-endian
+// digest (64 bytes little-endian integer) mod L -> 32 bytes little-endian.
+// 48-bit chunks land on whole bytes (6 each): 11 chunks cover 528 >= 512
+// bits, MSB chunk first; the top chunk only has 4 real bytes.
 inline void reduce512_mod_l(const uint8_t digest[64], uint8_t out[32]) {
   Acc320 acc;
-  for (int i = 15; i >= 0; i--) {  // 32-bit chunks, MSB chunk first
-    uint32_t w = uint32_t(digest[4 * i]) |
-                 (uint32_t(digest[4 * i + 1]) << 8) |
-                 (uint32_t(digest[4 * i + 2]) << 16) |
-                 (uint32_t(digest[4 * i + 3]) << 24);
-    acc.push_u32(w);
+  for (int k = 10; k >= 0; k--) {
+    uint64_t w = 0;
+    int base = 6 * k, nb = (k == 10) ? 4 : 6;
+    for (int j = nb - 1; j >= 0; j--) w = (w << 8) | digest[base + j];
+    acc.push_u48(w);
   }
   acc.canonicalize();
   acc.to_bytes_le(out);

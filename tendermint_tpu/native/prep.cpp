@@ -1,0 +1,196 @@
+// Batched Ed25519 verify-prep — CPython extension.
+//
+// Takes the verifier's items list [(pubkey, msg, signature), ...] and
+// produces the four device-bound arrays (pk[n,32], R[n,32], s[n,32],
+// h[n,32]) plus the precheck mask in ONE call: classification, length
+// checks, the s < L malleability check, h = SHA512(R||A||M) mod L —
+// everything ops/ed25519.prepare_batch_bytes and the BatchVerifier
+// dispatch loop otherwise do per item in Python. Replaces the host
+// half of the reference's per-signature VerifyBytes surface
+// (types/validator_set.go:240-265, go-crypto PubKeyEd25519.VerifyBytes).
+//
+// The SHA-512 loop runs with the GIL RELEASED over private copies of
+// the inputs, so a node pipelining several commits overlaps hashing
+// with device fetches. SHA-512 itself uses OpenSSL's one-shot SHA512()
+// when libcrypto.so.3 is loadable at runtime (AVX2 assembly, ~3x the
+// portable block function) and falls back to the portable Sha512 from
+// hostops.cpp otherwise.
+//
+// Returns None for input shapes the fast path does not cover —
+// secp256k1 keys (33-byte SEC1, host-verified by design), non-bytes
+// entries — and the Python wrapper then takes the general path, so
+// this extension can never change routing semantics, only speed.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include "hostops.cpp"
+
+namespace {
+
+typedef unsigned char *(*sha512_oneshot_fn)(const unsigned char *, size_t,
+                                            unsigned char *);
+sha512_oneshot_fn ossl_sha512 = nullptr;
+
+inline void sha512_ram(const uint8_t *r, const uint8_t *a,
+                       const uint8_t *m, size_t mlen, uint8_t out[64]) {
+    if (ossl_sha512 != nullptr) {
+        // one-shot wants contiguous input; R||A is 64 bytes, messages
+        // are vote/header sign-bytes (~100-300B), so a stack scratch
+        // covers the common case without an allocation
+        uint8_t scratch[512];
+        if (64 + mlen <= sizeof scratch) {
+            std::memcpy(scratch, r, 32);
+            std::memcpy(scratch + 32, a, 32);
+            std::memcpy(scratch + 64, m, mlen);
+            ossl_sha512(scratch, 64 + mlen, out);
+            return;
+        }
+        std::vector<uint8_t> big(64 + mlen);
+        std::memcpy(big.data(), r, 32);
+        std::memcpy(big.data() + 32, a, 32);
+        std::memcpy(big.data() + 64, m, mlen);
+        ossl_sha512(big.data(), big.size(), out);
+        return;
+    }
+    Sha512 s;
+    s.update(r, 32);
+    s.update(a, 32);
+    s.update(m, mlen);
+    s.final(out);
+}
+
+}  // namespace
+
+static PyObject *prep_items(PyObject *self, PyObject *arg) {
+    PyObject *seq = PySequence_Fast(arg, "prep_items expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    PyObject *pk_b = PyBytes_FromStringAndSize(nullptr, n * 32);
+    PyObject *rb_b = PyBytes_FromStringAndSize(nullptr, n * 32);
+    PyObject *s_b = PyBytes_FromStringAndSize(nullptr, n * 32);
+    PyObject *h_b = PyBytes_FromStringAndSize(nullptr, n * 32);
+    PyObject *pre_b = PyBytes_FromStringAndSize(nullptr, n);
+    if (!pk_b || !rb_b || !s_b || !h_b || !pre_b) {
+        Py_XDECREF(pk_b); Py_XDECREF(rb_b); Py_XDECREF(s_b);
+        Py_XDECREF(h_b); Py_XDECREF(pre_b); Py_DECREF(seq);
+        return nullptr;
+    }
+    uint8_t *pk = (uint8_t *)PyBytes_AS_STRING(pk_b);
+    uint8_t *rb = (uint8_t *)PyBytes_AS_STRING(rb_b);
+    uint8_t *sb = (uint8_t *)PyBytes_AS_STRING(s_b);
+    uint8_t *hb = (uint8_t *)PyBytes_AS_STRING(h_b);
+    uint8_t *pre = (uint8_t *)PyBytes_AS_STRING(pre_b);
+    std::memset(pk, 0, (size_t)n * 32);
+    std::memset(rb, 0, (size_t)n * 32);
+    std::memset(sb, 0, (size_t)n * 32);
+    std::memset(hb, 0, (size_t)n * 32);
+    std::memset(pre, 0, (size_t)n);
+
+    // Pass 1 (GIL held): copy messages into a private arena and pk/R/s
+    // into the output buffers. Copies make the hash loop independent of
+    // object lifetimes, so the GIL can drop for pass 2.
+    std::vector<uint8_t> arena;
+    arena.reserve((size_t)n * 160);
+    std::vector<uint64_t> moff((size_t)n + 1, 0);
+    bool fallback = false;
+    for (Py_ssize_t i = 0; i < n && !fallback; i++) {
+        PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject *fast =
+            PySequence_Fast(it, "prep_items items must be sequences");
+        if (fast == nullptr) {
+            PyErr_Clear();
+            fallback = true;
+            break;
+        }
+        if (PySequence_Fast_GET_SIZE(fast) != 3) {
+            Py_DECREF(fast);
+            fallback = true;
+            break;
+        }
+        PyObject *po = PySequence_Fast_GET_ITEM(fast, 0);
+        PyObject *mo = PySequence_Fast_GET_ITEM(fast, 1);
+        PyObject *so = PySequence_Fast_GET_ITEM(fast, 2);
+        if (!PyBytes_Check(po) || !PyBytes_Check(mo) || !PyBytes_Check(so)) {
+            Py_DECREF(fast);
+            fallback = true;  // memoryview/bytearray etc: general path
+            break;
+        }
+        Py_ssize_t plen = PyBytes_GET_SIZE(po);
+        const uint8_t *pp = (const uint8_t *)PyBytes_AS_STRING(po);
+        if (plen == 33 && (pp[0] == 2 || pp[0] == 3)) {
+            Py_DECREF(fast);
+            fallback = true;  // secp256k1: host-routed, general path
+            break;
+        }
+        Py_ssize_t slen = PyBytes_GET_SIZE(so);
+        moff[i + 1] = moff[i];
+        if (plen != 32 || slen != 64) {
+            Py_DECREF(fast);
+            continue;  // pre stays 0, buffers stay zeroed
+        }
+        const uint8_t *sp = (const uint8_t *)PyBytes_AS_STRING(so);
+        if (!scalar_below_l(sp + 32)) {
+            Py_DECREF(fast);
+            continue;
+        }
+        std::memcpy(pk + 32 * i, pp, 32);
+        std::memcpy(rb + 32 * i, sp, 32);
+        std::memcpy(sb + 32 * i, sp + 32, 32);
+        Py_ssize_t mlen = PyBytes_GET_SIZE(mo);
+        const uint8_t *mp = (const uint8_t *)PyBytes_AS_STRING(mo);
+        arena.insert(arena.end(), mp, mp + mlen);
+        moff[i + 1] = moff[i] + (uint64_t)mlen;
+        pre[i] = 1;
+        Py_DECREF(fast);
+    }
+    Py_DECREF(seq);
+    if (fallback) {
+        Py_DECREF(pk_b); Py_DECREF(rb_b); Py_DECREF(s_b);
+        Py_DECREF(h_b); Py_DECREF(pre_b);
+        Py_RETURN_NONE;
+    }
+
+    // Pass 2 (GIL released): h = SHA512(R || A || M) mod L
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!pre[i]) continue;
+        uint8_t digest[64];
+        sha512_ram(rb + 32 * i, pk + 32 * i, arena.data() + moff[i],
+                   (size_t)(moff[i + 1] - moff[i]), digest);
+        reduce512_mod_l(digest, hb + 32 * i);
+    }
+    Py_END_ALLOW_THREADS
+
+    PyObject *out = PyTuple_Pack(5, pk_b, rb_b, s_b, h_b, pre_b);
+    Py_DECREF(pk_b); Py_DECREF(rb_b); Py_DECREF(s_b);
+    Py_DECREF(h_b); Py_DECREF(pre_b);
+    return out;
+}
+
+static PyMethodDef prep_methods[] = {
+    {"prep_items", prep_items, METH_O,
+     "items [(pk, msg, sig), ...] -> (pk, R, s, h, pre) byte buffers, "
+     "or None when the batch needs the general Python path."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef prep_moduledef = {
+    PyModuleDef_HEAD_INIT, "_tmprep",
+    "Native batched Ed25519 verify-prep for tendermint_tpu", -1,
+    prep_methods,
+};
+
+PyMODINIT_FUNC PyInit__tmprep(void) {
+    void *crypto = dlopen("libcrypto.so.3", RTLD_LAZY | RTLD_LOCAL);
+    if (crypto != nullptr)
+        ossl_sha512 = (sha512_oneshot_fn)dlsym(crypto, "SHA512");
+    PyObject *m = PyModule_Create(&prep_moduledef);
+    if (m != nullptr)
+        PyModule_AddStringConstant(
+            m, "sha512_impl", ossl_sha512 ? "openssl" : "portable");
+    return m;
+}

@@ -300,8 +300,19 @@ def verify_batch_async(pubkeys, msgs, sigs, kernel=None, min_bucket=8):
     a caller with several chunks can enqueue them all and let device
     compute overlap host prep + transfers — on tunneled TPU links the
     per-call round-trip otherwise dominates end-to-end throughput."""
-    n = len(pubkeys)
     pk, rb, s_bytes, h_bytes, pre = prepare_batch_bytes(pubkeys, msgs, sigs)
+    res = verify_prepared_async(pk, rb, s_bytes, h_bytes,
+                                kernel=kernel, min_bucket=min_bucket)
+    return res, pre
+
+
+def verify_prepared_async(pk, rb, s_bytes, h_bytes, kernel=None,
+                          min_bucket=8):
+    """Dispatch already-prepared arrays (native.prep_items output or
+    prepare_batch_bytes minus the precheck): pads, routes through the
+    predecompressed-pubkey cache, picks the kernel. Returns the device
+    result; the caller masks with its precheck."""
+    n = pk.shape[0]
     # min_bucket > 8 when a sharded mesh kernel needs the batch axis
     # divisible by the mesh size (both are powers of two)
     m = _bucket(n, min_size=min_bucket)
@@ -313,7 +324,7 @@ def verify_batch_async(pubkeys, msgs, sigs, kernel=None, min_bucket=8):
         # decompression (cache keyed on batch content)
         res = _verify_cached_predecomp(pk_p, rb_p, sb_p, hb_p)
         if res is not None:
-            return res, pre
+            return res
     args = (jnp.asarray(pk_p), jnp.asarray(rb_p),
             jnp.asarray(sb_p), jnp.asarray(hb_p))
     if kernel is not None:
@@ -322,7 +333,7 @@ def verify_batch_async(pubkeys, msgs, sigs, kernel=None, min_bucket=8):
                      bits_from_bytes_dev(args[3]))
     else:
         res = verify_from_bytes_best(*args)
-    return res, pre
+    return res
 
 
 def verify_batch(pubkeys, msgs, sigs, kernel=None, min_bucket=8) -> np.ndarray:

@@ -165,3 +165,51 @@ def test_codec_differential_vs_pure():
         mod.canonical_dumps({1: "a"})
     # cdumps itself falls back and matches pure for non-str keys
     assert encoding.cdumps({1: "a"}) == encoding._pure_cdumps({1: "a"})
+
+
+def test_prep_items_differential_vs_python():
+    """native.prep_items must byte-match prepare_batch_bytes (the
+    Python/ctypes path) across valid, malformed, and boundary inputs,
+    and return None for shapes routed to the general path."""
+    import random
+
+    import numpy as np
+
+    from tendermint_tpu.ops import ed25519
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    if native._prep() is None:
+        pytest.skip("prep extension unavailable")
+
+    rng = random.Random(7)
+    items = []
+    for i in range(64):
+        seed = (i + 1).to_bytes(32, "little")
+        pk = ref.public_key(seed)
+        m = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 400)))
+        items.append((pk, m, ref.sign(seed, m)))
+    items[5] = (items[5][0][:31], items[5][1], items[5][2])      # short pk
+    items[7] = (items[7][0], items[7][1], items[7][2][:63])      # short sig
+    items[9] = (items[9][0], items[9][1],
+                items[9][2][:32] + ed25519.L_ORDER.to_bytes(32, "little"))
+    items[11] = (items[11][0], b"\x55" * 700, items[11][2])      # long msg
+    items[13] = (b"\x00" * 32, items[13][1], items[13][2])       # non-point
+
+    out = native.prep_items(items)
+    assert out is not None
+    pk, rb, sb, hb, pre = out
+    ref_out = ed25519.prepare_batch_bytes(
+        [i[0] for i in items], [i[1] for i in items],
+        [i[2] for i in items])
+    for got, want in zip((pk, rb, sb, hb, pre), ref_out):
+        assert np.array_equal(got, want)
+    assert not pre[5] and not pre[7] and not pre[9] and pre[13]
+
+    # shapes the fast path must hand back to the general path
+    assert native.prep_items(
+        [(b"\x02" + b"\x01" * 32, b"m", b"s" * 64)]) is None  # secp256k1
+    assert native.prep_items(
+        [(bytearray(32), b"m", b"s" * 64)]) is None           # non-bytes
+    assert native.prep_items([(b"a" * 32, b"m")]) is None     # 2-tuple
+    empty = native.prep_items([])
+    assert empty is not None and empty[4].shape == (0,)
