@@ -176,21 +176,32 @@ def _pt_identity(b):
     return (_zero_t(b), _one_t(b), _one_t(b), _zero_t(b))
 
 
-def _pt_add(p, q, d2):
+def _pt_add_tbl(p, q, want_t: bool = True):
+    """Add a table point q = (X2, Y2, Z2 | None, Td2) where Td2 is the
+    PRE-multiplied T2*d2 (one mul instead of two for the C term) and
+    Z2=None means the point is affine (Z2==1, Dv needs no mul — true
+    for every s-table entry). want_t=False skips the E*H output mul
+    when no consumer needs T (ladder h-adds feed 4 T-less doublings)."""
     X1, Y1, Z1, T1 = p
-    X2, Y2, Z2, T2 = q
+    X2, Y2, Z2, Td2 = q
     A = _mul_t(_sub_t(Y1, X1), _sub_t(Y2, X2))
     B = _mul_t(_add_t(Y1, X1), _add_t(Y2, X2))
-    C = _mul_t(_mul_t(T1, d2), T2)
-    Dv = _mul_small_t(_mul_t(Z1, Z2), 2)
+    C = _mul_t(T1, Td2)
+    Zp = Z1 if Z2 is None else _mul_t(Z1, Z2)
+    Dv = _mul_small_t(Zp, 2)
     E = _sub_t(B, A)
     F = _sub_t(Dv, C)
     G = _add_t(Dv, C)
     H = _add_t(B, A)
-    return (_mul_t(E, F), _mul_t(G, H), _mul_t(F, G), _mul_t(E, H))
+    return (_mul_t(E, F), _mul_t(G, H), _mul_t(F, G),
+            _mul_t(E, H) if want_t else None)
 
 
-def _pt_double(p):
+def _pt_double(p, want_t: bool = True):
+    """want_t=False drops the E*H mul: T is only ever consumed by an
+    add's C term, so the first three doublings of each 4-dbl window
+    block (and every doubling before an add that recomputes T anyway)
+    produce it for nothing."""
     X1, Y1, Z1, _ = p
     A = _square_t(X1)
     B = _square_t(Y1)
@@ -199,13 +210,14 @@ def _pt_double(p):
     G = _sub_t(B, A)
     F = _sub_t(G, C)
     H = _sub_t(_sub_t(_zero_t(A.shape[1]), A), B)
-    return (_mul_t(E, F), _mul_t(G, H), _mul_t(F, G), _mul_t(E, H))
+    return (_mul_t(E, F), _mul_t(G, H), _mul_t(F, G),
+            _mul_t(E, H) if want_t else None)
 
 
 def _pt_select(idx, pts):
-    """pts[idx] over a python list of points; idx int32[B]."""
+    """pts[idx] over a python list of equal-length tuples; idx int32[B]."""
     out = []
-    for comp in range(4):
+    for comp in range(len(pts[0])):
         acc = pts[0][comp]
         for k in range(1, len(pts)):
             acc = jnp.where((idx == k)[None, :], pts[k][comp], acc)
@@ -291,7 +303,7 @@ def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
 
     pk, rb:      int32[32, B] pubkey / signature-R bytes.
     dig_s/dig_h: int32[64, B] 4-bit scalar windows.
-    s_table:     int32[16, 4, 20] k*B constants.
+    s_table:     int32[16, 3, 20] k*B constants (X, Y, T*d2; Z==1).
     consts:      int32[4, 20]: D, D2, SQRT_M1, ONE(unused spare).
     Fixed exponentiations (sqrt-ratio's ^((p-5)/8), encode's ^(p-2)) use
     the classic curve25519 addition chain (_chain_250_t) instead of
@@ -348,27 +360,45 @@ def _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
     """Everything after decompression — table build, the Straus-w4
     ladder, affine conversion, encode, R compare — shared by the full
     and predecompressed kernels (inlined at trace time; one definition
-    keeps the two paths from diverging)."""
-    h_table = [_pt_identity(bsz), a_neg]
+    keeps the two paths from diverging).
+
+    Mul-count trims vs the textbook formulation (~14% fewer big muls
+    per window, measured ~8% whole-kernel): tables store T*d2 so each
+    add's C term is one mul; s-table points are affine (Z==1) so the
+    s-add's Z1*Z2 collapses; T itself is only ever consumed by an add's
+    C term, so the three leading doublings of each window block and the
+    final h-add skip the E*H output mul entirely (want_t=False)."""
+    xn, y, one, t = a_neg
+    td2_a = _mul_t(t, d2)
+    a_neg_tbl = (xn, y, one, td2_a)      # q-form for the ladder selects
+    a_neg_aff = (xn, y, None, t)         # affine q-form for table build
+    h_table = [_pt_identity(bsz), a_neg_tbl]
     for k in range(2, 16):
-        h_table.append(_pt_double(h_table[k // 2]) if k % 2 == 0
-                       else _pt_add(h_table[k - 1], a_neg, d2))
+        if k % 2 == 0:
+            x3, y3, z3, t3 = _pt_double(h_table[k // 2])
+        else:
+            x3, y3, z3, t3 = _pt_add_tbl(h_table[k - 1], a_neg_aff)
+        h_table.append((x3, y3, z3, _mul_t(t3, d2)))
     s_table = []
     for k in range(16):
         s_table.append(tuple(
             jnp.broadcast_to(s_table_ref[k, c][:, None], (NLIMBS, bsz))
-            for c in range(4)))
+            for c in range(3)))          # (X, Y, T*d2); Z == 1 implied
 
     def body(i, acc):
         w = 63 - i
         ds_w = jnp.where(ok, dig_s_ref[pl.ds(w, 1), :][0], 0)
         dh_w = jnp.where(ok, dig_h_ref[pl.ds(w, 1), :][0], 0)
-        acc = _pt_double(_pt_double(_pt_double(_pt_double(acc))))
-        acc = _pt_add(acc, _pt_select(ds_w, s_table), d2)
-        acc = _pt_add(acc, _pt_select(dh_w, h_table), d2)
-        return acc
+        acc = acc + (None,)
+        for _ in range(3):
+            acc = _pt_double(acc, want_t=False)
+        acc = _pt_double(acc, want_t=True)
+        sx, sy, std2 = _pt_select(ds_w, s_table)
+        acc = _pt_add_tbl(acc, (sx, sy, None, std2), want_t=True)
+        acc = _pt_add_tbl(acc, _pt_select(dh_w, h_table), want_t=False)
+        return acc[:3]
 
-    X, Y, Z, _ = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz))
+    X, Y, Z = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz)[:3])
 
     # ---- encode result + compare with R (curve.encode, transposed)
     zi = _inv_t(Z)
@@ -420,7 +450,7 @@ def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
                 pl.BlockSpec((32, tile), lambda i: (0, i)),
                 pl.BlockSpec((64, tile), lambda i: (0, i)),
                 pl.BlockSpec((64, tile), lambda i: (0, i)),
-                pl.BlockSpec((16, 4, NLIMBS), lambda i: (0, 0, 0)),
+                pl.BlockSpec((16, 3, NLIMBS), lambda i: (0, 0, 0)),
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
@@ -491,7 +521,7 @@ def verify_pallas_pre(xn_bytes, y_bytes, ok, rb_u8, s_bits, h_bits,
                 pl.BlockSpec((32, tile), lambda i: (0, i)),
                 pl.BlockSpec((64, tile), lambda i: (0, i)),
                 pl.BlockSpec((64, tile), lambda i: (0, i)),
-                pl.BlockSpec((16, 4, NLIMBS), lambda i: (0, 0, 0)),
+                pl.BlockSpec((16, 3, NLIMBS), lambda i: (0, 0, 0)),
                 pl.BlockSpec((NLIMBS,), lambda i: (0,)),
             ],
             out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
@@ -505,12 +535,14 @@ def verify_pallas_pre(xn_bytes, y_bytes, ok, rb_u8, s_bits, h_bits,
 
 @functools.lru_cache(maxsize=None)
 def _s_table_np():
-    out = np.zeros((16, 4, NLIMBS), np.int32)
+    """Affine k*B table, 3 comps: (X, Y, T*d2). Z==1 is implicit (the
+    s-add skips its Z1*Z2 mul), and T is pre-scaled by 2d so the add's
+    C term is a single mul."""
+    out = np.zeros((16, 3, NLIMBS), np.int32)
     for k, (x, y) in enumerate(curve._B_MULT_INTS):
         out[k, 0] = fe.to_limbs(x)
         out[k, 1] = fe.to_limbs(y)
-        out[k, 2] = fe.to_limbs(1)
-        out[k, 3] = fe.to_limbs(x * y % fe.P)
+        out[k, 2] = fe.to_limbs(x * y % fe.P * fe.D2_INT % fe.P)
     return out
 
 
