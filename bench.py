@@ -115,6 +115,36 @@ def verify_commit_100(n_vals: int = 100) -> dict:
             vs.verify_commit("bench-commit", bid, 7, commit, verifier=av)
         auto_s = min(auto_s, (time.perf_counter() - t0) / 5)
 
+    # device-only arm: the 100-signature commit on the 512-tile pallas
+    # kernel (the routing mid-size batches actually take), 50 pipelined
+    # reps per trial so the tunnel round trip amortizes — this is the
+    # compute a locally-attached chip would pay per commit (its
+    # dispatch overhead is ~1-3ms, not the tunnel's ~60-110ms)
+    import numpy as np
+    from tendermint_tpu.ops import ed25519 as ed
+    items, _ = vs.commit_verification_items("bench-commit", bid, 7, commit)
+    pk, rb, sb, hb, pre = ed.prepare_batch_bytes(
+        [i[0] for i in items], [i[1] for i in items],
+        [i[2] for i in items])
+    assert pre.all()
+    import jax.numpy as jnp
+    # pad to the 512 pallas tile — same routing verify_prepared_async
+    # now applies to mid-size batches (4x the lanes, ~4x less wall
+    # time than the jnp kernel at 128)
+    dargs = tuple(jnp.asarray(ed._pad_to(a, 512))
+                  for a in (pk, rb, sb, hb))
+    out = ed.verify_from_bytes_best(*dargs)
+    assert bool(np.asarray(out)[:n_vals].all())
+    # 50 reps/trial: a ~100ms tunnel round trip leaves <2ms residue per
+    # rep, so the figure is device compute, not link latency
+    dev_s = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        for _ in range(50):
+            out = ed.verify_from_bytes_best(*dargs)
+        out.block_until_ready()
+        dev_s = min(dev_s, (time.perf_counter() - t0) / 50)
+
     sv = ScalarVerifier()
     t0 = time.perf_counter()
     reps = 0
@@ -123,6 +153,9 @@ def verify_commit_100(n_vals: int = 100) -> dict:
         reps += 1
     scalar_s = (time.perf_counter() - t0) / reps
     return {
+        "device_only_ms_per_commit": round(dev_s * 1e3, 3),
+        "local_chip_expect_commits_per_sec": round(
+            1 / (dev_s + 0.002), 1),
         "product_auto_commits_per_sec": round(1 / auto_s, 1),
         "product_auto_ms_per_commit": round(auto_s * 1e3, 3),
         "commits_per_sec": round(1 / thr, 1),
@@ -132,10 +165,15 @@ def verify_commit_100(n_vals: int = 100) -> dict:
         "n_vals": n_vals,
         "scalar_commits_per_sec": round(1 / scalar_s, 1),
         "vs_baseline": round(scalar_s / thr, 2),
-        "note": "100-sig dispatches are bounded by the shared-tunnel "
-                "round trip (~60-100ms) and its ~8-way multiplexing "
-                "cap, not device compute (~1ms); nodes that batch "
-                "across commits (fast-sync/lite arms) amortize it",
+        "note": "100-sig dispatches here are bounded by the shared-"
+                "tunnel round trip (~60-110ms) and its ~8-way "
+                "multiplexing cap, not device compute "
+                "(device_only_ms_per_commit); local_chip_expect_* adds "
+                "a ~2ms local dispatch to the measured device time — "
+                "the scalar/batch breakeven is ~30-50 sigs there vs "
+                "~500 through the tunnel (docs/perf.md). Nodes that "
+                "batch across commits (fast-sync/lite arms, the "
+                "throughput arm above) amortize the round trip",
     }
 
 
@@ -212,22 +250,40 @@ def main() -> int:
     dt = min(dt_full, dt_pre)
     device_rate = n / dt  # honest: only the n real signatures count
 
-    # PRODUCT-path arm: the same 10k-signature commit through
-    # BatchVerifier.verify (host SHA-512 prep + chunking + padding +
-    # parallel verdict fetch INCLUDED — everything a node's
-    # verify_commit pays except building the vote objects). Steady
-    # state: repeated batches hit the predecompressed-pubkey cache.
+    # PRODUCT-path arms: the same 10k-signature commit through
+    # BatchVerifier (native prep + chunking + padding + parallel
+    # verdict fetch INCLUDED — everything a node's verify_commit pays
+    # except building the vote objects). Steady state: repeated batches
+    # hit the predecompressed-pubkey cache. Two shapes:
+    #   sync      — ONE blocking verify(): pays a full tunnel round
+    #               trip (~60-110ms here; ~1-3ms on a local chip), the
+    #               interactive lower bound.
+    #   sustained — 4 commits in flight via verify_async + threaded
+    #               resolvers, the shape a syncing/loaded node runs
+    #               (fast-sync windows, lite chains): round trips
+    #               amortize, host prep (GIL-released) overlaps device.
+    from concurrent.futures import ThreadPoolExecutor
     from tendermint_tpu.models.verifier import BatchVerifier
     jv = BatchVerifier("jax")
     items = list(zip(pubs, msgs, sigs))
     for _ in range(3):  # warm: compiles + cache fill (2nd sighting)
         assert bool(jv.verify(items).all())
-    dt_prod = float("inf")
+    dt_sync = float("inf")
     for _ in range(4):
         t0 = time.perf_counter()
         ok = jv.verify(items)
-        dt_prod = min(dt_prod, time.perf_counter() - t0)
+        dt_sync = min(dt_sync, time.perf_counter() - t0)
     assert bool(ok.all())
+    n_flight = 4
+    dt_prod = float("inf")
+    with ThreadPoolExecutor(max_workers=n_flight) as pool:
+        for _ in range(4):
+            t0 = time.perf_counter()
+            resolvers = [jv.verify_async(items) for _ in range(n_flight)]
+            outs = list(pool.map(lambda r: r(), resolvers))
+            dt_prod = min(dt_prod,
+                          (time.perf_counter() - t0) / n_flight)
+    assert all(bool(o.all()) for o in outs)
 
     base_rate = scalar_baseline_rate(pubs, msgs, sigs)
 
@@ -239,6 +295,9 @@ def main() -> int:
         "device_ms_predecompressed": round(dt_pre * 1e3, 2),
         "product_path_verifies_per_sec": round(n / dt_prod, 1),
         "product_path_ms": round(dt_prod * 1e3, 2),
+        "product_path_in_flight": 4,
+        "product_sync_verifies_per_sec": round(n / dt_sync, 1),
+        "product_sync_ms": round(dt_sync * 1e3, 2),
         "scalar_cpu_rate": round(base_rate, 1),
     }
 

@@ -229,10 +229,18 @@ class ConsensusReactor(Reactor):
                 # re-verify in a loop on the peer receive thread. The
                 # round/sequence windows also bound the dedup-set keys
                 # an attacker (even a current validator) can mint.
+                # round window: anything at or above our round (a node
+                # lagging the network by several rounds under timeout
+                # skew must still surface peers' heartbeats — the
+                # reference publishes any received heartbeat), bounded
+                # above so one validator's mintable dedup-key space
+                # (16 rounds x 512 sequences = 8192) never exceeds the
+                # seen-set clear threshold below — overflow-triggered
+                # clears would re-admit replays
                 if hb.height != rs.height or \
-                        not rs.round <= hb.round <= rs.round + 1 or \
-                        not 0 <= hb.sequence < 4096:
-                    return  # stale/future/implausible: drop
+                        not rs.round <= hb.round <= rs.round + 15 or \
+                        not 0 <= hb.sequence < 512:
+                    return  # stale/implausible: drop
                 hb_key = (hb.validator_address, hb.height, hb.round,
                           hb.sequence)
                 # one critical section across check->verify->publish:

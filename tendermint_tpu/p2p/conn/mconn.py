@@ -110,16 +110,26 @@ class MConnection:
             t.start()
             self._threads.append(t)
 
-    def stop(self) -> None:
+    def stop(self, join: bool = False, timeout: float = 2.0) -> None:
+        """join=True waits for the send/recv routines to exit before
+        returning (skipping whichever of them is the caller), so a
+        Switch teardown can guarantee no peer thread logs or touches
+        reactors after stop() returns — the reference's leaktest
+        discipline (glide.yaml pins goroutine-leak checking)."""
         with self._cond:
-            if self._stopped:
-                return
+            already = self._stopped
             self._stopped = True
             self._cond.notify_all()
-        try:
-            self.link.close()
-        except Exception:
-            pass
+        if not already:
+            try:
+                self.link.close()
+            except Exception:
+                pass
+        if join:
+            me = threading.current_thread()
+            for t in self._threads:
+                if t is not me:
+                    t.join(timeout)
 
     @property
     def running(self) -> bool:

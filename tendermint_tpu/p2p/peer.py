@@ -81,8 +81,8 @@ class Peer:
     def start(self) -> None:
         self.mconn.start()
 
-    def stop(self) -> None:
-        self.mconn.stop()
+    def stop(self, join: bool = False) -> None:
+        self.mconn.stop(join=join)
 
     @property
     def running(self) -> bool:

@@ -59,6 +59,8 @@ class Switch:
         self._listener: Optional[socket.socket] = None
         self._listen_addr: Optional[NetAddress] = None
         self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._started_peers: List[Peer] = []
         self._stopped = False
         self._lock = threading.Lock()
         # pluggable filters (switch.go:391-416)
@@ -99,8 +101,21 @@ class Switch:
                 self._listener.close()
             except OSError:
                 pass
+        # join each peer's conn threads before tearing reactors down:
+        # a recv routine that raced the close must finish its on_error
+        # (and any logging) while the process — and under pytest, the
+        # capture stream — is still intact. The started-peer registry
+        # (not the PeerSet) is iterated so a peer a recv thread already
+        # removed via stop_peer_for_error still gets joined.
         for peer in self.peers.list():
-            self.stop_peer_gracefully(peer)
+            self._remove_peer(peer, None, join=True)
+        with self._lock:
+            started, self._started_peers = self._started_peers, []
+        for peer in started:
+            peer.stop(join=True)
+        if self._accept_thread is not None:
+            self._accept_thread.join(2.0)
+            self._accept_thread = None
         for reactor in self.reactors.values():
             reactor.stop()
 
@@ -143,6 +158,7 @@ class Switch:
                              name="p2p-accept")
         t.start()
         self._threads.append(t)
+        self._accept_thread = t
         return self._listen_addr
 
     @property
@@ -274,6 +290,15 @@ class Switch:
         if not self.peers.add(peer):
             link.close()
             raise SwitchError(f"duplicate peer {peer.id}")
+        with self._lock:
+            # registry for join-on-stop: a recv thread that removes its
+            # own peer from the PeerSet (stop_peer_for_error race) must
+            # still be joined by Switch.stop(). Prune entries whose
+            # conn threads have exited to bound growth under churn.
+            self._started_peers = [
+                p for p in self._started_peers
+                if any(t.is_alive() for t in p.mconn._threads)]
+            self._started_peers.append(peer)
         peer.start()
         if self.trust_store is not None:
             self.trust_store.get_metric(peer.id).good_events(1)
@@ -301,10 +326,14 @@ class Switch:
 
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """switch.go StopPeerForError + reconnect for persistent peers."""
-        self.logger.error("stopping peer for error", peer=peer.id,
-                          err=reason)
-        if self.trust_store is not None:
-            self.trust_store.get_metric(peer.id).bad_events(1)
+        if not self._stopped:
+            # during Switch.stop() the conn-close races are expected;
+            # an "error" log (or a trust penalty) from a dying recv
+            # thread would smear well-behaved peers on every shutdown
+            self.logger.error("stopping peer for error", peer=peer.id,
+                              err=reason)
+            if self.trust_store is not None:
+                self.trust_store.get_metric(peer.id).bad_events(1)
         self._remove_peer(peer, reason)
         if peer.persistent and peer.dial_addr is not None and \
                 not self._stopped:
@@ -314,11 +343,11 @@ class Switch:
     def stop_peer_gracefully(self, peer: Peer) -> None:
         self._remove_peer(peer, None)
 
-    def _remove_peer(self, peer: Peer, reason) -> None:
+    def _remove_peer(self, peer: Peer, reason, join: bool = False) -> None:
         if not self.peers.has(peer.id):
             return
         self.peers.remove(peer)
-        peer.stop()
+        peer.stop(join=join)
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)

@@ -228,7 +228,8 @@ class Commit:
         fp = (self.block_id.hash, self.block_id.parts.total,
               self.block_id.parts.hash,
               tuple((v.signature, v.timestamp_ns, v.height, v.round,
-                     int(v.type), v.block_id.hash, v.block_id.parts.total,
+                     int(v.type), v.validator_address, v.validator_index,
+                     v.block_id.hash, v.block_id.parts.total,
                      v.block_id.parts.hash)
                     if v is not None else None
                     for v in self.precommits))
