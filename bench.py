@@ -314,7 +314,12 @@ def main() -> int:
             extra["commit100_error"] = repr(e)
         try:
             import bench_fastsync
-            extra["fastsync"] = bench_fastsync.run(
+            # config-4 shape: 5,000-tx blocks, 20k+ streamed blocks
+            extra["fastsync"] = bench_fastsync.run_large(
+                int(os.environ.get("TM_BENCH_FS_BLOCKS", "20480")),
+                64, 5000)
+            # r1-r3 continuity arm (32-tx blocks, verify-dominated)
+            extra["fastsync_smallblocks"] = bench_fastsync.run(
                 5120, 64, 32, scalar_baseline=True)
         except Exception as e:  # pragma: no cover
             extra["fastsync_error"] = repr(e)

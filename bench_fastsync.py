@@ -138,6 +138,233 @@ def sync_chain(gen, blocks, verify_window: int = 256,
     }
 
 
+class ChainBuilder:
+    """Streamed chain generation: build(n) returns the next n blocks,
+    carrying app/state forward — 20k-block runs never hold the whole
+    chain (VERDICT r3: scaling config 4 needs streamed generation, not
+    bigger arrays). Tx keys cycle over `key_space` heights so the app's
+    working set is bounded and realistic (overwrites) instead of
+    growing one key per tx forever."""
+
+    def __init__(self, n_vals: int, n_txs: int, key_space: int = 512,
+                 chain_id: str = "bench-sync"):
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+        from tendermint_tpu.abci.types import ValidatorUpdate
+        from tendermint_tpu.storage import MemDB, StateStore
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+
+        keys = [PrivKey.generate((i + 1).to_bytes(32, "little"))
+                for i in range(n_vals)]
+        self.signers = {
+            k.pubkey.address: _fast_signer((i + 1).to_bytes(32, "little"))
+            for i, k in enumerate(keys)}
+        self.gen = GenesisDoc(
+            chain_id=chain_id, genesis_time_ns=1,
+            validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                        for k in keys])
+        self.state = StateStore(MemDB()).load_or_genesis(self.gen)
+        self.conns = AppConns(local_client_creator(KVStoreApp()))
+        self.conns.consensus.init_chain(
+            [ValidatorUpdate(v.pubkey, v.voting_power)
+             for v in self.state.validators.validators], self.gen.chain_id)
+        self.n_txs = n_txs
+        self.key_space = key_space
+        self.part_size = \
+            self.state.consensus_params.block_gossip.block_part_size_bytes
+        self.height = 0
+        from tendermint_tpu.types.block import Commit
+        self.last_commit = Commit()
+
+    def build(self, n: int) -> list:
+        """Next n blocks. Applies through the app (headers embed real
+        app hashes) but skips block validation — the builder made the
+        block, the sync arm is what validates."""
+        from tendermint_tpu.state.execution import (exec_block_on_app,
+                                                    update_state)
+        from tendermint_tpu.types.block import BlockID, Commit
+        from tendermint_tpu.types.vote import Vote, VoteType
+
+        out = []
+        for _ in range(n):
+            h = self.height + 1
+            txs = [b"k%d.%d=v%d" % (h % self.key_space, i, h)
+                   for i in range(self.n_txs)]
+            block = self.state.make_block(h, txs, self.last_commit,
+                                          time_ns=h * 10 ** 9)
+            parts = block.make_part_set(self.part_size)
+            block_id = BlockID(block.hash(), parts.header())
+            out.append(block)
+            precommits = []
+            for idx, val in enumerate(self.state.validators.validators):
+                v = Vote(validator_address=val.address,
+                         validator_index=idx, height=h, round=0,
+                         timestamp_ns=h * 10 ** 9 + 1,
+                         type=VoteType.PRECOMMIT, block_id=block_id)
+                v.signature = self.signers[val.address](
+                    v.sign_bytes(self.gen.chain_id))
+                precommits.append(v)
+            self.last_commit = Commit(block_id, precommits)
+            responses = exec_block_on_app(self.conns.consensus, block,
+                                          self.state.validators)
+            new_state = update_state(self.state.copy(), block_id, block,
+                                     responses)
+            new_state.app_hash = self.conns.consensus.commit()
+            self.state = new_state
+            self.height = h
+        return out
+
+
+def run_large(n_blocks: int = 20480, n_vals: int = 64,
+              n_txs: int = 5000, wave: int = 2048,
+              verify_window: int = 256) -> dict:
+    """Config 4 at config-4 shape: n_txs-tx blocks, >=20k blocks,
+    streamed in waves (build untimed, sync timed, alternating).
+    Reports SUSTAINED blocks/s across every timed wave plus the best
+    single wave, against two baselines:
+
+      scalar_verify — same native host plane, one OpenSSL verify per
+          signature (isolates the device's crypto win; single run over
+          a prefix, flat per-block cost — policy fields emitted).
+      cpu_fallback  — the framework's full CPU fallback path
+          (TM_TPU_NO_NATIVE subprocess: pure-Python codec/merkle/app +
+          scalar verify), the baseline BASELINE.md defines for a
+          reference with no published numbers.
+    """
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    from tendermint_tpu.blockchain import BlockchainReactor
+    from tendermint_tpu.models.verifier import BatchVerifier
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.storage import BlockStore, MemDB, StateStore
+
+    # ---- warmup on a tiny same-shape chain: compiles the window batch
+    # shape AND the predecompressed kernel (2nd sighting of this same
+    # valset's pubkey batch), so no compile lands in a timed wave
+    warm_builder = ChainBuilder(n_vals, 32)
+    warm_blocks = warm_builder.build(2 * verify_window + 1)
+    sync_chain(warm_builder.gen, warm_blocks, verify_window=verify_window,
+               backend="auto")
+    sync_chain(warm_builder.gen, warm_blocks, verify_window=verify_window,
+               backend="auto")
+
+    builder = ChainBuilder(n_vals, n_txs)
+    t0 = time.perf_counter()
+
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_store.load_or_genesis(builder.gen)
+    conns = AppConns(local_client_creator(KVStoreApp()))
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], builder.gen.chain_id)
+    exec_ = BlockExecutor(state_store, conns.consensus,
+                          verifier=BatchVerifier("auto"))
+    reactor = BlockchainReactor(state, exec_, block_store, fast_sync=True,
+                                verify_window=verify_window)
+    avail: dict = {}
+
+    def send_request(peer_id: str, height: int) -> bool:
+        blk = avail.get(height)
+        if blk is None:
+            return False
+        reactor.pool.add_block(peer_id, blk, 1)
+        return True
+
+    reactor.pool.send_request = send_request
+    reactor.pool.max_pending_per_peer = 1 << 20
+
+    build_s = 0.0
+    timed_s = 0.0
+    best_wave = 0.0
+    done = 0
+    waves = 0
+    while done < n_blocks:
+        tb = time.perf_counter()
+        n_new = min(wave, n_blocks - done + 1)  # final wave: +sentinel
+        for blk in builder.build(n_new):
+            avail[blk.header.height] = blk
+        build_s += time.perf_counter() - tb
+        top = builder.height
+        target = min(top - 1, n_blocks)
+        reactor.pool.set_peer_height("bench-peer", top)
+        tw = time.perf_counter()
+        reactor.pool.make_next_requests()
+        while reactor.state.last_block_height < target:
+            if not reactor._sync_window():
+                reactor.pool.make_next_requests()
+        dt = time.perf_counter() - tw
+        timed_s += dt
+        n_wave = target - done
+        best_wave = max(best_wave, n_wave / dt)
+        done = target
+        waves += 1
+        for h in list(avail):
+            if h <= done - 1:
+                del avail[h]
+
+    out = {
+        "blocks": done, "n_vals": n_vals, "n_txs": n_txs,
+        "waves": waves, "wave_blocks": wave,
+        "verify_window": verify_window,
+        "seconds": round(timed_s, 3),
+        "build_seconds": round(build_s, 1),
+        "blocks_per_sec": round(done / timed_s, 1),
+        "best_wave_blocks_per_sec": round(best_wave, 1),
+        "txs_per_sec_applied": round(done * n_txs / timed_s, 1),
+        "verifies_per_sec": round(done * n_vals / timed_s, 1),
+        "verifier_stats": dict(exec_.verifier.stats),
+        "total_wall_seconds": round(time.perf_counter() - t0, 1),
+    }
+
+    # scalar-verify baseline: same native host plane, scalar crypto.
+    # Single run over a fresh prefix chain (flat per-block cost); the
+    # policy fields make the methodology explicit next to the ratio.
+    ns = min(512, n_blocks)
+    sb = ChainBuilder(n_vals, n_txs)
+    prefix = sb.build(ns + 1)
+    r_scalar = sync_chain(sb.gen, prefix, verify_window=verify_window,
+                          verifier=_ScalarVerifier())
+    out["scalar_verify"] = {
+        "blocks": ns, "blocks_per_sec": r_scalar["blocks_per_sec"],
+        "policy": "single run over a fresh prefix chain (device arm is "
+                  "sustained-over-all-waves; scalar per-block cost is "
+                  "flat so a prefix is representative)"}
+    out["vs_scalar_verify"] = round(
+        out["blocks_per_sec"] / r_scalar["blocks_per_sec"], 2)
+
+    # full CPU-fallback baseline, in a clean subprocess
+    import subprocess
+    try:
+        env = dict(os.environ, TM_TPU_NO_NATIVE="1", JAX_PLATFORMS="cpu")
+        env.pop("PYTHONPATH", None)
+        cp = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-fallback",
+             str(min(96, n_blocks)), str(n_vals), str(n_txs)],
+            capture_output=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        fb = json.loads(cp.stdout.decode().strip().splitlines()[-1])
+        out["cpu_fallback"] = fb
+        out["vs_cpu_fallback"] = round(
+            out["blocks_per_sec"] / fb["blocks_per_sec"], 2)
+    except Exception as e:  # pragma: no cover
+        out["cpu_fallback_error"] = repr(e)
+    return out
+
+
+def run_cpu_fallback(n_blocks: int, n_vals: int, n_txs: int) -> dict:
+    """Subprocess body: the framework's pure-CPU plane (no native
+    extensions, scalar verify) syncing a small prefix."""
+    builder = ChainBuilder(n_vals, n_txs)
+    blocks = builder.build(n_blocks + 1)
+    r = sync_chain(builder.gen, blocks, verifier=_ScalarVerifier())
+    return {"blocks": n_blocks, "blocks_per_sec": r["blocks_per_sec"],
+            "native": False,
+            "policy": "single run, pure-Python codec/merkle/app + "
+                      "scalar OpenSSL verify (TM_TPU_NO_NATIVE=1)"}
+
+
 def run(n_blocks: int = 5120, n_vals: int = 64, n_txs: int = 32,
         scalar_baseline: bool = True, scalar_blocks: int = 512) -> dict:
     """Build once, sync on the device path (best-of-3) vs the scalar-CPU
@@ -169,12 +396,31 @@ def run(n_blocks: int = 5120, n_vals: int = 64, n_txs: int = 32,
                                 verifier=_ScalarVerifier())
         out["scalar_blocks_per_sec"] = out_scalar["blocks_per_sec"]
         out["scalar_blocks"] = ns
+        # methodology beside the ratio (the arms differ deliberately):
+        # device = best-of-3 over the full chain (tunnel-load policy,
+        # same as the headline), scalar = ONE run over a prefix slice
+        # (flat per-block cost; full-length scalar would take minutes)
+        out["device_trials"] = 3
+        out["scalar_trials"] = 1
         out["vs_scalar"] = round(
             out["blocks_per_sec"] / out_scalar["blocks_per_sec"], 2)
     return out
 
 
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--cpu-fallback":
+        print(json.dumps(run_cpu_fallback(
+            int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))))
+        return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "--large":
+        res = run_large(*[int(a) for a in sys.argv[2:]])
+        print(json.dumps({
+            "metric": "fastsync_5ktx_blocks_per_sec",
+            "value": res["blocks_per_sec"], "unit": "blocks/sec",
+            "vs_baseline": res.get("vs_cpu_fallback", 0.0),
+            "extra": res,
+        }))
+        return 0
     n_blocks = int(sys.argv[1]) if len(sys.argv) > 1 else 5120
     n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 64
     n_txs = int(sys.argv[3]) if len(sys.argv) > 3 else 32

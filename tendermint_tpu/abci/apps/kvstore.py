@@ -36,7 +36,7 @@ import zlib
 from tendermint_tpu.abci.app import BaseApplication
 from tendermint_tpu.abci.types import (
     ResultCheckTx, ResultDeliverTx, ResultEndBlock, ResultInfo,
-    ResultQuery, ValidatorUpdate,
+    ResultQuery, UniformDeliverResults, ValidatorUpdate,
 )
 from tendermint_tpu.ops import merkle
 
@@ -48,9 +48,60 @@ N_BUCKETS = 256   # app-hash buckets; must be a power of two. Tradeoff:
 _EMPTY_BUCKET = hashlib.sha256(b"\x00").digest()
 
 
+class _NativeStoreView:
+    """Read-only Mapping facade over the native KV core, so callers
+    (query, info, tests doing `app.store.get`/`dict(app.store)`) see
+    the same dict-like surface the pure-Python app exposes."""
+
+    def __init__(self, mod, core):
+        self._mod = mod
+        self._core = core
+
+    def get(self, k, default=None):
+        v = self._mod.get(self._core, k)
+        return default if v is None else v
+
+    def __getitem__(self, k):
+        v = self._mod.get(self._core, k)
+        if v is None:
+            raise KeyError(k)
+        return v
+
+    def __contains__(self, k):
+        return self._mod.get(self._core, k) is not None
+
+    def __len__(self):
+        return self._mod.size(self._core)
+
+    def __bool__(self):
+        return len(self) > 0
+
+    def items(self):
+        return self._mod.items(self._core)
+
+    def keys(self):
+        return [k for k, _ in self.items()]
+
+    def __iter__(self):
+        return iter(self.keys())
+
+
 class KVStoreApp(BaseApplication):
-    def __init__(self):
-        self.store: dict[bytes, bytes] = {}
+    def __init__(self, use_native: bool = True):
+        # native core (kvcore.cpp): the plain-kv DeliverTx path, the
+        # bucketed accumulator, and the commit hash in C++ — the pure
+        # Python fields below stay authoritative when it is absent
+        # (TM_TPU_NO_NATIVE / no compiler / use_native=False), and the
+        # two implementations are differential-tested for identical
+        # app hashes
+        from tendermint_tpu import native
+        self._kvmod = native.kv() if use_native else None
+        if self._kvmod is not None:
+            self._core = self._kvmod.kv_new()
+            self.store = _NativeStoreView(self._kvmod, self._core)
+        else:
+            self._core = None
+            self.store: dict[bytes, bytes] = {}
         self.height = 0
         self.app_hash = b""
         self.tx_count = 0
@@ -128,10 +179,27 @@ class KVStoreApp(BaseApplication):
             k, _, v = tx.partition(b"=")
         else:
             k = v = tx
-        self.store[k] = v
-        self._dirty.add(k)
+        if self._core is not None:
+            self._kvmod.set_one(self._core, k, v)
+        else:
+            self.store[k] = v
+            self._dirty.add(k)
         self.tx_count += 1
         return ResultDeliverTx(tags={"app.key": k.decode("utf-8", "replace")})
+
+    def deliver_tx_batch(self, txs):
+        """One native call for a block of plain kv txs; any empty or
+        `val:` tx routes the whole batch through the per-tx path (the
+        native core scans before mutating, so no partial application).
+        Returns a lazy UniformDeliverResults — same per-tx results on
+        access, none of the 5,000-object construction up front."""
+        if self._core is not None and txs:
+            out = self._kvmod.deliver_batch(self._core, txs)
+            if isinstance(out, tuple):
+                keys, packed = out
+                self.tx_count += len(txs)
+                return UniformDeliverResults(keys, packed=packed)
+        return [self.deliver_tx(tx) for tx in txs]
 
     def commit(self) -> bytes:
         # App hash = Merkle root over N_BUCKETS bucket digests; a bucket
@@ -140,6 +208,9 @@ class KVStoreApp(BaseApplication):
         # state-size independent — see the module docstring for the
         # construction and its tradeoff.
         self.height += 1
+        if self._core is not None:
+            self.app_hash = self._kvmod.commit(self._core)
+            return self.app_hash
         if self._dirty:
             sha = hashlib.sha256
             pd = self._pair_digest

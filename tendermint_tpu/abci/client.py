@@ -115,6 +115,9 @@ class LocalClient:
 
     def deliver_tx_batch(self, txs):
         with self.lock:
+            batch = getattr(self.app, "deliver_tx_batch", None)
+            if batch is not None:
+                return batch(txs)
             return [self.app.deliver_tx(tx) for tx in txs]
 
     def end_block(self, height):

@@ -89,6 +89,72 @@ class ResultDeliverTx:
                    o.get("tags", {}))
 
 
+class UniformDeliverResults:
+    """Lazy sequence of N DeliverTx results sharing one outcome
+    (code, data, log) and differing only in a per-key tag — the shape a
+    batched native app (kvstore deliver_batch) produces for a block of
+    plain txs. Materializing 5,000 ResultDeliverTx objects + tag dicts
+    costs ~10ms/block of pure interpreter time; consumers that only
+    need the hashed fields (results_hash: code+data) or the count never
+    pay it, and per-tx consumers (event firing, tx indexing) build each
+    result on access.
+
+    `uniform = True` is the protocol marker results_hash and
+    ABCIResponses.to_obj key their fast paths on."""
+
+    __slots__ = ("keys", "code", "data", "log", "tag_key", "_packed")
+    uniform = True
+
+    def __init__(self, keys, code: int = CodeTypeOK, data: bytes = b"",
+                 log: str = "", tag_key: str = "app.key",
+                 packed: bytes = None):
+        self.keys = keys
+        self.code = code
+        self.data = data
+        self.log = log
+        self.tag_key = tag_key
+        self._packed = packed  # length-prefixed key blob, if prebuilt
+
+    def __len__(self):
+        return len(self.keys)
+
+    def __iter__(self):
+        for i in range(len(self.keys)):
+            yield self[i]
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self.keys)))]
+        return ResultDeliverTx(
+            self.code, self.data, self.log,
+            {self.tag_key: self.keys[i].decode("utf-8", "replace")})
+
+    def to_compact_obj(self) -> dict:
+        # keys as ONE length-prefixed blob hexed once — per-key .hex()
+        # over 5,000 keys costs more than the rest of the persist path
+        packed = self._packed
+        if packed is None:
+            packed = b"".join(
+                len(k).to_bytes(4, "little") + k for k in self.keys)
+        return {"code": self.code, "data": self.data.hex(),
+                "log": self.log, "tag_key": self.tag_key,
+                "n": len(self.keys), "keys_packed": packed.hex()}
+
+    @classmethod
+    def from_compact_obj(cls, o: dict) -> "UniformDeliverResults":
+        if "keys_packed" in o:
+            blob = bytes.fromhex(o["keys_packed"])
+            keys, pos = [], 0
+            for _ in range(o["n"]):
+                ln = int.from_bytes(blob[pos:pos + 4], "little")
+                keys.append(blob[pos + 4:pos + 4 + ln])
+                pos += 4 + ln
+        else:  # older persisted form: per-key hex list
+            keys = [bytes.fromhex(k) for k in o["keys"]]
+        return cls(keys, o["code"], bytes.fromhex(o["data"]), o["log"],
+                   o["tag_key"])
+
+
 @dataclass
 class ResultEndBlock:
     validator_updates: List[ValidatorUpdate] = field(default_factory=list)

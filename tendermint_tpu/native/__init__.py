@@ -111,9 +111,11 @@ _prep_tried = False
 
 
 def _build_ext(src: str, lib: str, opt: str = "-O2",
-               extra_deps: tuple = ()) -> Optional[str]:
+               extra_deps: tuple = (), std: str = "c++17") -> Optional[str]:
     """Build a CPython extension .so from src, cached next to it.
-    extra_deps: sources the src #includes, for staleness checking."""
+    extra_deps: sources the src #includes, for staleness checking.
+    std: per-extension — only kvcore needs c++20 (transparent
+    unordered_map lookup); the rest stay buildable on older g++."""
     try:
         deps = (src,) + tuple(extra_deps)
         if os.path.exists(lib) and all(
@@ -126,7 +128,7 @@ def _build_ext(src: str, lib: str, opt: str = "-O2",
     if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
         return None
     tmp = lib + f".{os.getpid()}.tmp"
-    cmd = ["g++", opt, "-shared", "-fPIC", "-std=c++17",
+    cmd = ["g++", opt, "-shared", "-fPIC", f"-std={std}",
            f"-I{inc}", src, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -137,10 +139,10 @@ def _build_ext(src: str, lib: str, opt: str = "-O2",
 
 
 def _load_ext(modname: str, src: str, lib: str, opt: str = "-O2",
-              extra_deps: tuple = ()):
+              extra_deps: tuple = (), std: str = "c++17"):
     """Build (if stale) and import a CPython extension; None on any
     failure — callers fall back to pure Python."""
-    path = _build_ext(src, lib, opt, extra_deps)
+    path = _build_ext(src, lib, opt, extra_deps, std)
     if path is None:
         return None
     try:
@@ -186,6 +188,28 @@ def prep_items(items):
     return as_mat(pk_b), as_mat(rb_b), as_mat(s_b), as_mat(h_b), pre
 
 
+# -- native KVStore core (kvcore.cpp) ---------------------------------------
+
+_KV_SRC = os.path.join(_HERE, "kvcore.cpp")
+_KV_LIB = os.path.join(_HERE, "_tmkv.so")
+_kv_mod = None
+_kv_tried = False
+
+
+def kv():
+    """The _tmkv extension module (native KVStore core), or None."""
+    global _kv_mod, _kv_tried
+    with _lock:
+        if _kv_tried:
+            return _kv_mod
+        _kv_tried = True
+        if os.environ.get("TM_TPU_NO_NATIVE"):
+            return None
+        _kv_mod = _load_ext("_tmkv", _KV_SRC, _KV_LIB, "-O3",
+                            extra_deps=(_SRC,), std="c++20")
+        return _kv_mod
+
+
 def _pack(items: List[bytes]):
     data = b"".join(items)
     n = len(items)
@@ -223,6 +247,15 @@ def sha256_batch(items: List[bytes]) -> Optional[List[bytes]]:
 
 
 def merkle_root(items: List[bytes]) -> Optional[bytes]:
+    # large trees: the CPython-API path (no ctypes offset packing) —
+    # the wrapper overhead exceeds the hashing at ~5,000 leaves
+    if len(items) >= 256:
+        mod = _prep()
+        if mod is not None:
+            try:
+                return mod.merkle_root_items(items)
+            except TypeError:
+                pass  # non-bytes items: fall through to the packer
     lib = _load()
     if lib is None:
         return None

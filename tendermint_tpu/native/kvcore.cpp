@@ -1,0 +1,371 @@
+// Native KVStore core — CPython extension.
+//
+// The C++ half of abci/apps/kvstore.py: the plain "key=value" DeliverTx
+// path, the CRC32-bucketed additive-accumulator app hash, and the
+// bucket-Merkle commit, all in one call per block. Replaces ~20us/tx of
+// interpreter work (dict ops + per-tx hashlib + result objects) that
+// caps 5,000-tx blocks at ~10 blocks/s — the fast-sync replay workload
+// of /root/reference/blockchain/reactor.go:216-302 applies every one of
+// those txs through the app, so at config-4 shape the app plane must be
+// native for the device verify win to show at all.
+//
+// Semantics are pinned BY the Python app (kvstore.py deliver_tx/commit):
+// the two paths are differential-tested for byte-equal app hashes and
+// store contents (tests/test_native.py); val: txs and empty txs make
+// deliver_batch return the index they occur at so the wrapper can fall
+// back to the per-tx Python path for that whole block — validator
+// bookkeeping never lives here.
+//
+// Accumulator spec (must match kvstore.py commit()):
+//   bucket(k)   = crc32(k) & 255
+//   pair(k,v)   = sha256(le32(len k) || k || le32(len v) || v)
+//   acc[b]      = sum of pair digests as little-endian ints mod 2^256
+//   digest(b)   = sha256(0x00 || le256(acc[b]) || le64(count[b]))
+//                 (empty bucket: sha256(0x00))
+//   app_hash    = merkle root over the 256 bucket digests
+//                 (b"\x00"*32 when the store is empty)
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <array>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+
+#include "hostops.cpp"
+
+namespace {
+
+constexpr int KV_BUCKETS = 256;
+
+// CRC-32 (zlib/IEEE 802.3 polynomial, reflected) — table built at init.
+uint32_t crc_table[256];
+
+void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int j = 0; j < 8; j++)
+            c = (c & 1) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
+        crc_table[i] = c;
+    }
+}
+
+inline uint32_t crc32_of(const uint8_t *p, size_t n) {
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; i++)
+        c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+struct Acc256 {
+    uint64_t v[4] = {0, 0, 0, 0};
+
+    void add_le(const uint8_t d[32]) {
+        unsigned __int128 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            uint64_t w = 0;
+            for (int j = 7; j >= 0; j--) w = (w << 8) | d[8 * i + j];
+            carry += (unsigned __int128)v[i] + w;
+            v[i] = (uint64_t)carry;
+            carry >>= 64;
+        }  // mod 2^256: carry out drops
+    }
+
+    void sub_le(const uint8_t d[32]) {
+        unsigned __int128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            uint64_t w = 0;
+            for (int j = 7; j >= 0; j--) w = (w << 8) | d[8 * i + j];
+            unsigned __int128 sub = (unsigned __int128)w + borrow;
+            uint64_t lo = (uint64_t)sub;
+            borrow = sub >> 64;
+            if (v[i] < lo) borrow++;
+            v[i] -= lo;
+        }  // mod 2^256: borrow out drops
+    }
+
+    void to_le(uint8_t out[32]) const {
+        for (int i = 0; i < 4; i++)
+            for (int j = 0; j < 8; j++)
+                out[8 * i + j] = uint8_t(v[i] >> (8 * j));
+    }
+};
+
+inline void pair_digest(std::string_view k, std::string_view v,
+                        uint8_t out[32]) {
+    Sha256 s;
+    uint8_t len[4];
+    uint32_t kl = (uint32_t)k.size(), vl = (uint32_t)v.size();
+    for (int i = 0; i < 4; i++) len[i] = uint8_t(kl >> (8 * i));
+    s.update(len, 4);
+    s.update((const uint8_t *)k.data(), k.size());
+    for (int i = 0; i < 4; i++) len[i] = uint8_t(vl >> (8 * i));
+    s.update(len, 4);
+    s.update((const uint8_t *)v.data(), v.size());
+    s.final(out);
+}
+
+// heterogeneous lookup (C++20): deliver txs probe with string_view, so
+// no temporary std::string is built for keys that already exist — at
+// 5,000 txs/block the allocation traffic was the dominant cost
+struct SvHash {
+    using is_transparent = void;
+    size_t operator()(std::string_view s) const {
+        return std::hash<std::string_view>{}(s);
+    }
+};
+struct SvEq {
+    using is_transparent = void;
+    bool operator()(std::string_view a, std::string_view b) const {
+        return a == b;
+    }
+};
+
+struct KVEntry {
+    std::string value;
+    std::array<uint8_t, 32> digest;  // cached pair digest
+};
+
+struct KVCore {
+    std::unordered_map<std::string, KVEntry, SvHash, SvEq> store;
+    Acc256 acc[KV_BUCKETS];
+    uint64_t count[KV_BUCKETS] = {0};
+    uint8_t bucket_digest[KV_BUCKETS * 32];
+    bool bucket_dirty[KV_BUCKETS] = {false};
+
+    KVCore() {
+        uint8_t empty[32];
+        Sha256 s;
+        uint8_t z = 0;
+        s.update(&z, 1);
+        s.final(empty);
+        for (int b = 0; b < KV_BUCKETS; b++)
+            std::memcpy(bucket_digest + 32 * b, empty, 32);
+    }
+
+    // set k=v, updating the bucket accumulator (matches the dirty-key
+    // replay in kvstore.py commit(), applied eagerly per key)
+    void set(std::string_view k, std::string_view v) {
+        int b = crc32_of((const uint8_t *)k.data(), k.size()) &
+                (KV_BUCKETS - 1);
+        uint8_t d[32];
+        pair_digest(k, v, d);
+        auto it = store.find(k);
+        if (it != store.end()) {
+            acc[b].sub_le(it->second.digest.data());
+            it->second.value.assign(v.data(), v.size());
+            std::memcpy(it->second.digest.data(), d, 32);
+        } else {
+            count[b]++;
+            KVEntry e;
+            e.value.assign(v.data(), v.size());
+            std::memcpy(e.digest.data(), d, 32);
+            store.emplace(std::string(k), std::move(e));
+        }
+        acc[b].add_le(d);
+        bucket_dirty[b] = true;
+    }
+
+    void refresh_digests() {
+        for (int b = 0; b < KV_BUCKETS; b++) {
+            if (!bucket_dirty[b]) continue;
+            bucket_dirty[b] = false;
+            uint8_t *out = bucket_digest + 32 * b;
+            if (count[b] == 0) {
+                Sha256 s;
+                uint8_t z = 0;
+                s.update(&z, 1);
+                s.final(out);
+            } else {
+                uint8_t buf[41];
+                buf[0] = 0;
+                acc[b].to_le(buf + 1);
+                for (int i = 0; i < 8; i++)
+                    buf[33 + i] = uint8_t(count[b] >> (8 * i));
+                Sha256 s;
+                s.update(buf, 41);
+                s.final(out);
+            }
+        }
+    }
+};
+
+void kv_capsule_destroy(PyObject *cap) {
+    delete (KVCore *)PyCapsule_GetPointer(cap, "tm_kvcore");
+}
+
+KVCore *kv_from(PyObject *cap) {
+    return (KVCore *)PyCapsule_GetPointer(cap, "tm_kvcore");
+}
+
+}  // namespace
+
+static PyObject *kv_new(PyObject *, PyObject *) {
+    return PyCapsule_New(new KVCore(), "tm_kvcore", kv_capsule_destroy);
+}
+
+// deliver_batch(core, txs) -> (keys list, packed key blob), or the int
+// index of the first tx the native path does not handle (empty /
+// "val:" prefixed / non-bytes) — caller replays the WHOLE batch
+// through Python, so the native store must not be touched before that
+// scan completes. The packed blob is the length-prefixed key
+// concatenation UniformDeliverResults persists, built here because
+// 5,000 per-key concats in Python cost more than the delivery.
+static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
+    PyObject *cap, *txs;
+    if (!PyArg_ParseTuple(args, "OO", &cap, &txs)) return nullptr;
+    KVCore *core = kv_from(cap);
+    if (core == nullptr) return nullptr;
+    PyObject *seq = PySequence_Fast(txs, "deliver_batch expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    // pass 1: scan for txs needing the Python path (no mutations yet)
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *t = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyBytes_Check(t) || PyBytes_GET_SIZE(t) == 0 ||
+            (PyBytes_GET_SIZE(t) >= 4 &&
+             std::memcmp(PyBytes_AS_STRING(t), "val:", 4) == 0)) {
+            Py_DECREF(seq);
+            return PyLong_FromSsize_t(i);
+        }
+    }
+    PyObject *keys = PyList_New(n);
+    if (keys == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    std::string packed;  // length-prefixed key blob for compact persist
+    packed.reserve((size_t)n * 16);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *t = PySequence_Fast_GET_ITEM(seq, i);
+        const char *p = PyBytes_AS_STRING(t);
+        Py_ssize_t len = PyBytes_GET_SIZE(t);
+        const char *eq = (const char *)std::memchr(p, '=', len);
+        PyObject *kobj;
+        std::string_view k, v;
+        if (eq != nullptr) {
+            k = std::string_view(p, eq - p);
+            v = std::string_view(eq + 1, len - (eq - p) - 1);
+            kobj = PyBytes_FromStringAndSize(p, eq - p);
+        } else {
+            k = v = std::string_view(p, len);
+            kobj = t;
+            Py_INCREF(t);
+        }
+        if (kobj == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(keys);
+            return nullptr;
+        }
+        core->set(k, v);
+        PyList_SET_ITEM(keys, i, kobj);
+        uint32_t kl = (uint32_t)k.size();
+        char lenb[4];
+        for (int j = 0; j < 4; j++) lenb[j] = char(kl >> (8 * j));
+        packed.append(lenb, 4);
+        packed.append(k.data(), k.size());
+    }
+    Py_DECREF(seq);
+    PyObject *packed_b = PyBytes_FromStringAndSize(
+        packed.data(), (Py_ssize_t)packed.size());
+    if (packed_b == nullptr) {
+        Py_DECREF(keys);
+        return nullptr;
+    }
+    PyObject *out = PyTuple_Pack(2, keys, packed_b);
+    Py_DECREF(keys);
+    Py_DECREF(packed_b);
+    return out;
+}
+
+// set_one(core, key, value): the single-tx Python fallback still must
+// keep the native accumulator in sync when mixed batches occur.
+static PyObject *kv_set(PyObject *, PyObject *args) {
+    PyObject *cap;
+    const char *k, *v;
+    Py_ssize_t kl, vl;
+    if (!PyArg_ParseTuple(args, "Oy#y#", &cap, &k, &kl, &v, &vl))
+        return nullptr;
+    KVCore *core = kv_from(cap);
+    if (core == nullptr) return nullptr;
+    core->set(std::string_view(k, (size_t)kl),
+              std::string_view(v, (size_t)vl));
+    Py_RETURN_NONE;
+}
+
+// commit(core) -> 32-byte app hash (b"\x00"*32 for an empty store)
+static PyObject *kv_commit(PyObject *, PyObject *arg) {
+    KVCore *core = kv_from(arg);
+    if (core == nullptr) return nullptr;
+    if (core->store.empty())
+        return PyBytes_FromStringAndSize(
+            "\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0"
+            "\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0", 32);
+    core->refresh_digests();
+    uint8_t out[32];
+    std::vector<uint8_t> level(core->bucket_digest,
+                               core->bucket_digest + KV_BUCKETS * 32);
+    root_from_digests(level, KV_BUCKETS, out);
+    return PyBytes_FromStringAndSize((const char *)out, 32);
+}
+
+static PyObject *kv_get(PyObject *, PyObject *args) {
+    PyObject *cap;
+    const char *k;
+    Py_ssize_t kl;
+    if (!PyArg_ParseTuple(args, "Oy#", &cap, &k, &kl)) return nullptr;
+    KVCore *core = kv_from(cap);
+    if (core == nullptr) return nullptr;
+    auto it = core->store.find(std::string_view(k, (size_t)kl));
+    if (it == core->store.end()) Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize(it->second.value.data(),
+                                     (Py_ssize_t)it->second.value.size());
+}
+
+static PyObject *kv_size(PyObject *, PyObject *arg) {
+    KVCore *core = kv_from(arg);
+    if (core == nullptr) return nullptr;
+    return PyLong_FromSize_t(core->store.size());
+}
+
+static PyObject *kv_items(PyObject *, PyObject *arg) {
+    KVCore *core = kv_from(arg);
+    if (core == nullptr) return nullptr;
+    PyObject *out = PyList_New((Py_ssize_t)core->store.size());
+    if (out == nullptr) return nullptr;
+    Py_ssize_t i = 0;
+    for (const auto &kv : core->store) {
+        PyObject *pair = Py_BuildValue(
+            "(y#y#)", kv.first.data(), (Py_ssize_t)kv.first.size(),
+            kv.second.value.data(), (Py_ssize_t)kv.second.value.size());
+        if (pair == nullptr) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i++, pair);
+    }
+    return out;
+}
+
+static PyMethodDef kv_methods[] = {
+    {"kv_new", kv_new, METH_NOARGS, "new KV core handle"},
+    {"deliver_batch", kv_deliver_batch, METH_VARARGS,
+     "(core, txs) -> (keys, packed), or int index of first non-kv tx"},
+    {"set_one", kv_set, METH_VARARGS, "(core, key, value)"},
+    {"commit", kv_commit, METH_O, "(core) -> 32-byte app hash"},
+    {"get", kv_get, METH_VARARGS, "(core, key) -> value | None"},
+    {"size", kv_size, METH_O, "(core) -> number of keys"},
+    {"items", kv_items, METH_O, "(core) -> [(key, value), ...]"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef kv_moduledef = {
+    PyModuleDef_HEAD_INIT, "_tmkv",
+    "Native KVStore core for tendermint_tpu", -1, kv_methods,
+};
+
+PyMODINIT_FUNC PyInit__tmkv(void) {
+    crc_init();
+    return PyModule_Create(&kv_moduledef);
+}

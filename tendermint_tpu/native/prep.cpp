@@ -171,7 +171,42 @@ static PyObject *prep_items(PyObject *self, PyObject *arg) {
     return out;
 }
 
+// merkle_root_items(list[bytes]) -> 32-byte root. Same spec as
+// tm_merkle_root, but taking the Python list directly: the ctypes
+// wrapper's per-item offset packing costs more than the hashing for
+// the 5,000-leaf tx trees the sync loop validates per block. Items are
+// copied to a private arena so the hash loop can drop the GIL.
+static PyObject *merkle_root_items(PyObject *self, PyObject *arg) {
+    PyObject *seq = PySequence_Fast(arg, "merkle_root_items expects a list");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    std::vector<uint8_t> arena;
+    std::vector<uint64_t> off((size_t)n + 1, 0);
+    arena.reserve((size_t)n * 32);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyBytes_Check(it)) {
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_TypeError,
+                            "merkle_root_items: items must be bytes");
+            return nullptr;
+        }
+        const uint8_t *p = (const uint8_t *)PyBytes_AS_STRING(it);
+        Py_ssize_t len = PyBytes_GET_SIZE(it);
+        arena.insert(arena.end(), p, p + len);
+        off[i + 1] = off[i] + (uint64_t)len;
+    }
+    Py_DECREF(seq);
+    uint8_t out[32];
+    Py_BEGIN_ALLOW_THREADS
+    tm_merkle_root(arena.data(), off.data(), (uint64_t)n, out);
+    Py_END_ALLOW_THREADS
+    return PyBytes_FromStringAndSize((const char *)out, 32);
+}
+
 static PyMethodDef prep_methods[] = {
+    {"merkle_root_items", merkle_root_items, METH_O,
+     "list[bytes] -> 32-byte merkle root (same spec as ops/merkle)"},
     {"prep_items", prep_items, METH_O,
      "items [(pk, msg, sig), ...] -> (pk, R, s, h, pre) byte buffers, "
      "or None when the batch needs the general Python path."},

@@ -91,6 +91,45 @@ def root_from_digests_host(digests) -> bytes:
     return _final_hash(n, level[0])
 
 
+def root_from_repeated_digest(digest: bytes, n: int) -> bytes:
+    """Root over n copies of one leaf digest in O(log n) — byte-equal
+    to root_from_digests_host(digest * n). Levels of such a tree are
+    runs of at most a handful of distinct values (the repeated digest,
+    zero-padding, and their boundary combinations), so each level is a
+    run-length merge instead of n hashes. This is the results-hash of
+    the common all-txs-OK block, where every DeliverTx leaf encodes
+    identically (types/results.go:20-49 hashes only code+data)."""
+    if n <= 0:
+        return _final_hash(0, EMPTY_DIGEST)
+    runs = [(digest, n)]
+    pad = _padded_size(n) - n
+    if pad:
+        runs.append((EMPTY_DIGEST, pad))
+    total = n + pad
+    while total > 1:
+        new_runs: list[tuple[bytes, int]] = []
+        carry = None
+        for d, c in runs:
+            if carry is not None:
+                new_runs.append((node_hash(carry, d), 1))
+                carry = None
+                c -= 1
+            if c >= 2:
+                new_runs.append((node_hash(d, d), c // 2))
+            if c % 2:
+                carry = d
+        assert carry is None  # padded totals stay even at every level
+        # coalesce adjacent equal runs so the run count stays O(1)
+        runs = []
+        for d, c in new_runs:
+            if runs and runs[-1][0] == d:
+                runs[-1] = (d, runs[-1][1] + c)
+            else:
+                runs.append((d, c))
+        total //= 2
+    return _final_hash(n, runs[0][0])
+
+
 def proof_host(items: list[bytes], index: int):
     """Returns (root, aunts) — aunts leaf-up, each 32 bytes."""
     n = len(items)

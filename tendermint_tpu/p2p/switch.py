@@ -294,10 +294,13 @@ class Switch:
             # registry for join-on-stop: a recv thread that removes its
             # own peer from the PeerSet (stop_peer_for_error race) must
             # still be joined by Switch.stop(). Prune entries whose
-            # conn threads have exited to bound growth under churn.
+            # conn threads have exited to bound growth under churn —
+            # but KEEP not-yet-started entries (empty thread list):
+            # another thread may be between registering and start().
             self._started_peers = [
                 p for p in self._started_peers
-                if any(t.is_alive() for t in p.mconn._threads)]
+                if not p.mconn._threads or
+                any(t.is_alive() for t in p.mconn._threads)]
             self._started_peers.append(peer)
         peer.start()
         if self.trust_store is not None:

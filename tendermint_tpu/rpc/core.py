@@ -240,6 +240,14 @@ class RPCCore:
         results = self.env.state_store.load_abci_responses(height)
         if results is None:
             raise RPCError(-32000, f"no results for height {height}")
+        if "deliver_txs_uniform" in results:
+            # the compact persisted form is internal: external clients
+            # always see the per-tx deliver_txs shape
+            from tendermint_tpu.state.execution import ABCIResponses
+            resp = ABCIResponses.from_obj(results)
+            results = {"deliver_txs": [r.to_obj()
+                                       for r in resp.deliver_txs],
+                       "end_block": resp.end_block_obj}
         return jsonify({"height": height, "results": results})
 
     def commit(self, height: int = 0) -> dict:

@@ -62,7 +62,14 @@ class MockEvidencePool:
 
 def results_hash(results: List[ResultDeliverTx]) -> bytes:
     """Deterministic hash of (code, data) per tx → LastResultsHash
-    (types/results.go:20-49)."""
+    (types/results.go:20-49). Uniform batches (every leaf identical —
+    the normal all-OK block) hash ONE leaf and merkleize the repeated
+    digest buffer natively instead of encoding N objects."""
+    if getattr(results, "uniform", False) and len(results) > 0:
+        leaf = encoding.cdumps({"code": results.code,
+                                "data": results.data.hex()})
+        return merkle.root_from_repeated_digest(
+            merkle.leaf_hash(leaf), len(results))
     leaves = [encoding.cdumps({"code": r.code, "data": r.data.hex()})
               for r in results]
     return merkle.root_host(leaves)
@@ -81,11 +88,23 @@ class ABCIResponses:
         return results_hash(self.deliver_txs)
 
     def to_obj(self):
+        dt = self.deliver_txs
+        if getattr(dt, "uniform", False):
+            # compact persisted form: one template + the key list
+            # instead of N per-tx dicts (loss-free — from_obj rebuilds
+            # the same lazy sequence, so results_hash and per-tx reads
+            # round-trip byte-identically)
+            return {"deliver_txs_uniform": dt.to_compact_obj(),
+                    "end_block": self.end_block_obj}
         return {"deliver_txs": [r.to_obj() for r in self.deliver_txs],
                 "end_block": self.end_block_obj}
 
     @classmethod
     def from_obj(cls, o):
+        if "deliver_txs_uniform" in o:
+            from tendermint_tpu.abci.types import UniformDeliverResults
+            return cls(UniformDeliverResults.from_compact_obj(
+                o["deliver_txs_uniform"]), o["end_block"])
         return cls([ResultDeliverTx.from_obj(r) for r in o["deliver_txs"]],
                    o["end_block"])
 

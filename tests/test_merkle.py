@@ -61,3 +61,14 @@ def test_order_sensitivity():
     items = [b"a", b"b", b"c"]
     swapped = [b"b", b"a", b"c"]
     assert merkle.root_host(items) != merkle.root_host(swapped)
+
+
+def test_root_from_repeated_digest_matches_generic():
+    from tendermint_tpu.ops import merkle
+
+    d = merkle.leaf_hash(b"repeat-me")
+    for n in [1, 2, 3, 4, 5, 7, 8, 9, 15, 16, 17, 31, 100, 1000, 5000]:
+        assert merkle.root_from_repeated_digest(d, n) == \
+            merkle.root_from_digests_host(d * n), n
+    assert merkle.root_from_repeated_digest(d, 0) == \
+        merkle.root_from_digests_host(b"")

@@ -213,3 +213,40 @@ def test_prep_items_differential_vs_python():
     assert native.prep_items([(b"a" * 32, b"m")]) is None     # 2-tuple
     empty = native.prep_items([])
     assert empty is not None and empty[4].shape == (0,)
+
+
+def test_kvcore_differential_vs_python_app():
+    """Native KV core vs the pure-Python KVStoreApp: identical app
+    hashes, store contents, and results hashes across mixed batches,
+    key overwrites, and val: txs (which route to the Python path)."""
+    import random
+
+    from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+    from tendermint_tpu.state.execution import results_hash
+
+    if native.kv() is None:
+        pytest.skip("kv extension unavailable")
+
+    pure = KVStoreApp(use_native=False)
+    assert pure._core is None
+    nat = KVStoreApp()
+    assert nat._core is not None
+
+    rng = random.Random(13)
+    for block in range(6):
+        txs = []
+        for i in range(200):
+            k = b"k%d" % rng.randrange(150)   # frequent overwrites
+            v = bytes(rng.randrange(256) for _ in range(rng.randrange(20)))
+            txs.append(k + b"=" + v if rng.random() < 0.8 else k)
+        if block == 3:
+            txs.insert(7, b"val:" + b"aa" * 32 + b"/5")  # python fallback
+        r_nat = nat.deliver_tx_batch(txs)
+        r_pure = [pure.deliver_tx(tx) for tx in txs]
+        assert results_hash(r_nat) == results_hash(r_pure)
+        assert [r.to_obj() for r in r_nat] == [r.to_obj() for r in r_pure]
+        assert nat.commit() == pure.commit(), f"block {block}"
+    assert dict(nat.store.items()) == pure.store
+    assert len(nat.store) == len(pure.store)
+    assert nat.store.get(b"k1") == pure.store.get(b"k1")
+    assert nat.tx_count == pure.tx_count

@@ -312,3 +312,18 @@ def test_ws_client_auto_reconnects_and_resubscribes():
             srv2.stop()
     finally:
         c.close()
+
+
+def test_block_results_expands_uniform_batches(rpc_node):
+    """Blocks applied through the native batch path persist the compact
+    deliver_txs_uniform form; block_results must still serve the
+    external per-tx deliver_txs shape."""
+    c = client(rpc_node)
+    res = c.call("broadcast_tx_commit", tx=b"uniform-k=uniform-v")
+    h = res["height"]
+    br = c.call("block_results", height=h)
+    dt = br["results"]["deliver_txs"]
+    assert "deliver_txs_uniform" not in br["results"]
+    assert any(r["code"] == 0 and
+               r.get("tags", {}).get("app.key") == "uniform-k"
+               for r in dt)
