@@ -326,7 +326,11 @@ def main() -> int:
         try:
             import bench_lite
             extra["lite"] = bench_lite.run(2000, 64)
-            extra["lite_100k"] = bench_lite.run_large(100_000, 16)
+            # config 5 at FULL scale: 1M headers x 64 validators,
+            # streamed build (TPU batch signing) / timed certify waves
+            extra["lite_1m"] = bench_lite.run_streamed(
+                int(os.environ.get("TM_BENCH_LITE_HEADERS", "1000000")),
+                64)
         except Exception as e:  # pragma: no cover
             extra["lite_error"] = repr(e)
         try:

@@ -128,33 +128,118 @@ def run(n_headers: int = 2000, n_vals: int = 64,
     return out
 
 
-def run_large(n_headers: int = 100_000, n_vals: int = 16) -> dict:
-    """BASELINE-scale config 5: a long header chain certified through
-    the STREAMED certify_chain (windowed dispatch, device/host overlap,
-    bounded memory). Build is excluded from the timed region; the
-    scalar-vs-device ratio comes from run() — this arm reports the
-    sustained end-to-end rate at scale."""
+def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
+                 wave: int = 16384) -> dict:
+    """Config 5 at FULL scale: 1M headers x 64 validators, streamed —
+    build a wave (untimed: TPU batch signing via ops/ed25519.sign_batch,
+    ~5-6us/signature end-to-end), certify it (timed), alternate. Memory
+    stays bounded at one wave; sustained headers/s across all timed
+    waves is the headline, per VERDICT r3 item 4."""
     from tendermint_tpu.lite.certifier import certify_chain
+    from tendermint_tpu.lite.types import FullCommit, SignedHeader
     from tendermint_tpu.models.verifier import default_verifier
+    from tendermint_tpu.ops import ed25519 as ed
+    from tendermint_tpu.types import PrivKey
+    from tendermint_tpu.types.block import (BlockID, Commit, Header,
+                                            PartSetHeader)
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote, VoteType
 
     chain_id = "bench-lite"
-    t0 = time.perf_counter()
-    fcs, valset = build_chain(n_headers, n_vals)
-    build_s = time.perf_counter() - t0
+    seeds = [(i + 1).to_bytes(32, "little") for i in range(n_vals)]
+    keys = [PrivKey.generate(s) for s in seeds]
+    valset = ValidatorSet([Validator(k.pubkey.ed25519, 10) for k in keys])
+    order = {k.pubkey.address: i for i, k in enumerate(keys)}
+    idx_of = [order[v.address] for v in valset.validators]
+    vals = valset.validators
+    vhash = valset.hash()
 
-    default_verifier().warmup(2048 * n_vals)
-    t0 = time.perf_counter()
-    certify_chain(chain_id, fcs, trusted=valset)
-    dt = time.perf_counter() - t0
+    default_verifier().warmup(wave * n_vals)
+    # the final PARTIAL wave ends with a short certify window whose
+    # batch shape nothing above compiles — warm it too, or its JIT
+    # compile lands inside the last timed wave
+    win = max(64, 32768 // n_vals)
+    tail_h = (n_headers % wave) % win
+    if tail_h:
+        default_verifier().warmup(tail_h * n_vals)
+    t_all = time.perf_counter()
+    build_s = 0.0
+    warm_s = 0.0
+    timed_s = 0.0
+    best_wave = 0.0
+    done = 0
+    while done < n_headers:
+        tb = time.perf_counter()
+        n_w = min(wave, n_headers - done)
+        heights = range(done + 1, done + n_w + 1)
+        headers, bids, msgs = [], [], []
+        for h in heights:
+            header = Header(chain_id=chain_id, height=h, time_ns=h,
+                            validators_hash=vhash,
+                            app_hash=h.to_bytes(32, "big"))
+            bid = BlockID(header.hash(), PartSetHeader(1, b"\x22" * 32))
+            headers.append(header)
+            bids.append(bid)
+            # every validator signs the SAME canonical bytes (v0.16
+            # sign bytes carry no validator identity; one timestamp)
+            msgs.append(Vote(vals[0].address, 0, h, 0, h,
+                             VoteType.PRECOMMIT, bid).sign_bytes(chain_id))
+        sig_seeds = [seeds[idx_of[j]]
+                     for _ in range(n_w) for j in range(n_vals)]
+        sig_msgs = [m for m in msgs for _ in range(n_vals)]
+        sigs = ed.sign_batch(sig_seeds, sig_msgs)
+        fcs = []
+        for i, h in enumerate(heights):
+            precommits = [None] * n_vals
+            base = i * n_vals
+            for j, val in enumerate(vals):
+                v = Vote(val.address, j, h, 0, h, VoteType.PRECOMMIT,
+                         bids[i])
+                v.signature = sigs[base + j]
+                precommits[j] = v
+            fcs.append(FullCommit(
+                SignedHeader(headers[i], Commit(bids[i], precommits),
+                             bids[i]), valset))
+        build_s += time.perf_counter() - tb
+
+        if done == 0:
+            # one untimed mini-certify first: the verifier's warmup()
+            # compiles the FULL kernel shapes, but certify's steady
+            # state runs the predecompressed variant (engages on the
+            # 2nd sighting of this valset's padded pubkey batch) — its
+            # ~40s Mosaic compile must not land in wave 1's timed run
+            tw = time.perf_counter()
+            certify_chain(chain_id, fcs[:1024], trusted=valset)
+            warm_s = time.perf_counter() - tw
+
+        tw = time.perf_counter()
+        certify_chain(chain_id, fcs, trusted=valset)
+        dt = time.perf_counter() - tw
+        timed_s += dt
+        best_wave = max(best_wave, n_w / dt)
+        done += n_w
     return {
-        "headers_per_sec": round(n_headers / dt, 1),
-        "headers": n_headers, "vals_per_header": n_vals,
-        "sig_verifies_per_sec": round(n_headers * n_vals / dt, 1),
-        "certify_s": round(dt, 3), "build_s": round(build_s, 1),
+        "headers_per_sec": round(done / timed_s, 1),
+        "best_wave_headers_per_sec": round(best_wave, 1),
+        "headers": done, "vals_per_header": n_vals,
+        "waves": (done + wave - 1) // wave, "wave_headers": wave,
+        "sig_verifies_per_sec": round(done * n_vals / timed_s, 1),
+        "certify_s": round(timed_s, 3), "build_s": round(build_s, 1),
+        "warm_s": round(warm_s, 1),
+        "total_wall_s": round(time.perf_counter() - t_all, 1),
     }
 
 
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--streamed":
+        args = [int(a) for a in sys.argv[2:]]
+        r = run_streamed(*args)
+        print(json.dumps({
+            "metric": "lite_chain_certify_1m",
+            "value": r["headers_per_sec"],
+            "unit": "headers/sec", "vs_baseline": 0.0, "extra": r,
+        }))
+        return 0
     n_headers = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
     n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 64
     r = run(n_headers, n_vals)

@@ -230,11 +230,17 @@ static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
             return PyLong_FromSsize_t(i);
         }
     }
+    // pass 2: parse + allocate EVERY Python object before the first
+    // core->set — an allocation failure after partial application
+    // would leave the native store diverged from what the caller
+    // believes was applied (a consensus-visible state fork on replay)
     PyObject *keys = PyList_New(n);
     if (keys == nullptr) {
         Py_DECREF(seq);
         return nullptr;
     }
+    std::vector<std::pair<std::string_view, std::string_view>> kvs(
+        (size_t)n);
     std::string packed;  // length-prefixed key blob for compact persist
     packed.reserve((size_t)n * 16);
     for (Py_ssize_t i = 0; i < n; i++) {
@@ -258,7 +264,7 @@ static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
             Py_DECREF(keys);
             return nullptr;
         }
-        core->set(k, v);
+        kvs[i] = {k, v};
         PyList_SET_ITEM(keys, i, kobj);
         uint32_t kl = (uint32_t)k.size();
         char lenb[4];
@@ -266,16 +272,19 @@ static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
         packed.append(lenb, 4);
         packed.append(k.data(), k.size());
     }
-    Py_DECREF(seq);
     PyObject *packed_b = PyBytes_FromStringAndSize(
         packed.data(), (Py_ssize_t)packed.size());
-    if (packed_b == nullptr) {
+    PyObject *out = packed_b ? PyTuple_Pack(2, keys, packed_b) : nullptr;
+    Py_XDECREF(packed_b);
+    if (out == nullptr) {
+        Py_DECREF(seq);
         Py_DECREF(keys);
         return nullptr;
     }
-    PyObject *out = PyTuple_Pack(2, keys, packed_b);
+    // pass 3: apply (no Python allocation from here on)
+    for (auto &kv : kvs) core->set(kv.first, kv.second);
+    Py_DECREF(seq);
     Py_DECREF(keys);
-    Py_DECREF(packed_b);
     return out;
 }
 

@@ -533,6 +533,71 @@ def verify_pallas_pre(xn_bytes, y_bytes, ok, rb_u8, s_bits, h_bits,
     return out[0].astype(jnp.bool_)
 
 
+def _sign_kernel(dig_r_ref, s_table_ref, out_ref):
+    """enc(r*B) for a batch of scalars — the device half of batched
+    Ed25519 SIGNING (R = r*B; the host derives r, k, and s). A strict
+    subset of the verify ladder: fixed-base windows only (no h-table,
+    no decompress), 3 T-less doublings + 1 full doubling + 1 affine
+    s-add per window, then the shared invert/encode tail."""
+    bsz = dig_r_ref.shape[-1]
+    s_table = []
+    for k in range(16):
+        s_table.append(tuple(
+            jnp.broadcast_to(s_table_ref[k, c][:, None], (NLIMBS, bsz))
+            for c in range(3)))
+
+    def body(i, acc):
+        w = 63 - i
+        dr_w = dig_r_ref[pl.ds(w, 1), :][0]
+        acc = acc + (None,)
+        for _ in range(3):
+            acc = _pt_double(acc, want_t=False)
+        acc = _pt_double(acc, want_t=True)
+        sx, sy, std2 = _pt_select(dr_w, s_table)
+        # the only add per window: its own T has no consumer (the next
+        # window's 4th doubling recomputes T), so want_t=False
+        acc = _pt_add_tbl(acc, (sx, sy, None, std2), want_t=False)
+        return acc[:3]
+
+    X, Y, Z = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz)[:3])
+    zi = _inv_t(Z)
+    xa = _mul_t(X, zi)
+    ya = _mul_t(Y, zi)
+    by = _to_bytes_t(ya)
+    sign_bit = _to_bytes_t(xa)[0] & 1
+    top = by[31] | (sign_bit << 7)
+    out_ref[:] = jnp.concatenate([by[:31], top[None, :]], axis=0)
+
+
+def sign_pallas_rB(r_bytes_u8, tile: int = DEFAULT_TILE,
+                   interpret: bool = False):
+    """uint8[N,32] little-endian scalars (each < L) -> uint8[N,32]
+    canonical encodings of r*B."""
+    n = r_bytes_u8.shape[0]
+    tile = min(tile, n)
+    assert n % tile == 0, (n, tile)
+    r_t = r_bytes_u8.astype(jnp.int32).T                # [32, N]
+    bits = (r_t[:, None, :] >> jnp.arange(8, dtype=jnp.int32)[None, :, None]) & 1
+    dig = bits.reshape(256, n).reshape(64, 4, n)
+    dig_r = dig[:, 0] + 2 * dig[:, 1] + 4 * dig[:, 2] + 8 * dig[:, 3]
+
+    out = pl.pallas_call(
+        _sign_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, n), jnp.int32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(n // tile,),
+            in_specs=[
+                pl.BlockSpec((64, tile), lambda i: (0, i)),
+                pl.BlockSpec((16, 3, NLIMBS), lambda i: (0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((32, tile), lambda i: (0, i)),
+        ),
+        interpret=interpret,
+    )(dig_r, jnp.asarray(_s_table_np()))
+    return out.T.astype(jnp.uint8)
+
+
 @functools.lru_cache(maxsize=None)
 def _s_table_np():
     """Affine k*B table, 3 comps: (X, Y, T*d2). Z==1 is implicit (the

@@ -82,3 +82,50 @@ def test_transposed_byte_roundtrip():
     back = jax.jit(ladder_pallas._to_bytes_t)(limbs)
     assert (np.asarray(back).T == b).all()
     assert (np.asarray(high) == 0).all()  # values < p have bit 255 clear
+
+
+def test_sign_kernel_interpret_matches_reference():
+    """enc(r*B) from the pallas sign kernel (interpreter) vs the
+    RFC 8032 reference point arithmetic, plus the full sign_batch host
+    pipeline (phase1 nonce, device R, phase2 finalize) cross-checked
+    against scalar OpenSSL signatures via monkeypatched device."""
+    import numpy as np
+
+    from tendermint_tpu.ops import ed25519, ladder_pallas
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    rng = np.random.default_rng(9)
+    n = 8
+    rs = [int.from_bytes(bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+                         "little") % ed25519.L_ORDER for _ in range(n)]
+    r_u8 = np.zeros((n, 32), np.uint8)
+    for i, r in enumerate(rs):
+        r_u8[i] = np.frombuffer(r.to_bytes(32, "little"), np.uint8)
+    enc = np.asarray(ladder_pallas.sign_pallas_rB(
+        jnp.asarray(r_u8), tile=8, interpret=True))
+    for i, r in enumerate(rs):
+        want = ref.point_compress(ref.point_mul(r, ref.BASE))
+        assert enc[i].tobytes() == want, i
+
+    # full pipeline differential: route the device step through the
+    # interpreter and compare finished signatures with OpenSSL
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+        Ed25519PrivateKey
+    seeds = [bytes([i + 1] * 32) for i in range(8)]
+    msgs = [b"sign-batch-%d" % i * (i + 1) for i in range(8)]
+    orig_pallas = ed25519._pallas_available
+    orig_dev = ed25519._sign_rb_pallas
+    ed25519._pallas_available = lambda: True
+    # strip sign_batch's 512 padding before the interpreter (each tile
+    # is a full 64-window ladder interpretation — 64 tiles would take
+    # minutes; the 8 real rows are one tile)
+    ed25519._sign_rb_pallas = lambda r: ladder_pallas.sign_pallas_rB(
+        r[:8], tile=8, interpret=True)
+    try:
+        sigs = ed25519.sign_batch(seeds, msgs)
+    finally:
+        ed25519._pallas_available = orig_pallas
+        ed25519._sign_rb_pallas = orig_dev
+    for seed, m, sig in zip(seeds, msgs, sigs):
+        want = Ed25519PrivateKey.from_private_bytes(seed).sign(m)
+        assert sig == want
