@@ -158,8 +158,8 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
     # the final PARTIAL wave ends with a short certify window whose
     # batch shape nothing above compiles — warm it too, or its JIT
     # compile lands inside the last timed wave
-    win = max(64, 32768 // n_vals)
-    tail_h = (n_headers % wave) % win
+    from tendermint_tpu.lite.certifier import default_window
+    tail_h = (n_headers % wave) % default_window(n_vals)
     if tail_h:
         default_verifier().warmup(tail_h * n_vals)
     t_all = time.perf_counter()

@@ -135,7 +135,204 @@ def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
     }
 
 
+def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
+               duration_s: float = 30.0) -> dict:
+    """Config 1 over REAL sockets: n_vals separate OS processes
+    (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
+    txs injected over HTTP RPC by background spammer threads; commit
+    rate and committed tx/s measured from block metas over a wall-clock
+    window. The analogue of the reference's dockerized
+    test/p2p/atomic_broadcast testnet, recorded as a NUMBER (the
+    in-process `run()` above isolates the engine; this arm includes
+    every socket, handshake, and gossip cost). On a 1-core bench host
+    the four nodes and the spammers share one core — the figure is a
+    floor, not the engine ceiling."""
+    import json as _json
+    import os
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _free_port_block(k):
+        import random
+        for _ in range(50):
+            base = random.randrange(20000, 60000, 2) | 1
+            socks = []
+            try:
+                for off in range(k):
+                    s = _socket.socket()
+                    s.bind(("127.0.0.1", base + off))
+                    socks.append(s)
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+        raise RuntimeError("no free port block")
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    net = tempfile.mkdtemp(prefix="bench-socknet-")
+    base = _free_port_block(2 * n_vals)
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+         "--n", str(n_vals), "--output", net, "--base-port", str(base),
+         "--chain-id", "bench-socknet"],
+        env=env, check=True, capture_output=True, timeout=120)
+    for i in range(n_vals):
+        cfg_path = os.path.join(net, f"node{i}", "config", "config.json")
+        cfg = _json.load(open(cfg_path))
+        cfg["consensus"].update({
+            "timeout_propose": 400, "timeout_propose_delta": 100,
+            "timeout_prevote": 200, "timeout_prevote_delta": 100,
+            "timeout_precommit": 200, "timeout_precommit_delta": 100,
+            "timeout_commit": 100,
+            "max_block_size_txs": n_txs_target})
+        # a few blocks of backlog: enough to keep every block at
+        # the 1000-tx reap cap, small enough that per-commit
+        # recheck + mempool-WAL rewrite stay O(small)
+        cfg["mempool"] = dict(cfg.get("mempool", {}), size=4000)
+        _json.dump(cfg, open(cfg_path, "w"))
+
+    procs, logs = [], []
+    stop = threading.Event()
+    sent = [0]
+    try:
+        for i in range(n_vals):
+            log = open(os.path.join(net, f"node{i}.log"), "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tendermint_tpu.cli",
+                 "--home", os.path.join(net, f"node{i}"),
+                 "node", "--p2p", "--no-fast-sync",
+                 "--rpc-laddr", f"tcp://127.0.0.1:{base + 2 * i + 1}",
+                 "--max-seconds", "600"],
+                env=env, stdout=log, stderr=subprocess.STDOUT))
+
+        from tendermint_tpu.rpc.client import JSONRPCClient
+        clients = [JSONRPCClient(f"http://127.0.0.1:{base + 2 * i + 1}")
+                   for i in range(n_vals)]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                if all(c.call("status")["latest_block_height"] >= 2
+                       for c in clients):
+                    break
+            except Exception:
+                pass
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError("socket-testnet node died during boot")
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("socket testnet made no progress")
+
+        def spam(tid):
+            # tm-bench shape: fire-and-forget casts over one persistent
+            # websocket (an HTTP round trip per tx caps injection at
+            # ~500 tx/s on this shared core — the chain outruns it)
+            from tendermint_tpu.rpc.client import WSClient
+            ws = None
+            i = 0
+            while not stop.is_set():
+                try:
+                    if ws is None:
+                        ws = WSClient("127.0.0.1",
+                                      base + 2 * (tid % n_vals) + 1)
+                    for _ in range(64):
+                        ws.cast("broadcast_tx_sync",
+                                tx=(b"s%d.%d=v" % (tid, i)).hex())
+                        i += 1
+                        sent[0] += 1
+                    # periodic sync point: don't outrun the server,
+                    # and back off while the backlog is deep enough
+                    while not stop.is_set() and ws.call(
+                            "num_unconfirmed_txs",
+                            timeout=30.0)["n_txs"] > 3000:
+                        time.sleep(0.2)
+                except Exception:
+                    if ws is not None:
+                        try:
+                            ws.close()
+                        except Exception:
+                            pass
+                        ws = None
+                    time.sleep(0.2)
+
+        spammers = [threading.Thread(target=spam, args=(t,), daemon=True)
+                    for t in range(2)]
+        for t in spammers:
+            t.start()
+        # pre-fill: HTTP injection (~500 tx/s on this shared core) is
+        # slower than commit throughput, so build a mempool BACKLOG
+        # first — the measured window then reaps config-1-shaped
+        # (1000-tx) blocks, the sustained-load profile of the
+        # reference's atomic_broadcast testnet
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                if clients[0].call("num_unconfirmed_txs")[
+                        "n_txs"] >= 2500:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+
+        h0 = clients[0].call("status")["latest_block_height"]
+        t0 = time.perf_counter()
+        time.sleep(duration_s)
+        h1 = clients[0].call("status")["latest_block_height"]
+        dt = time.perf_counter() - t0
+        stop.set()
+        txs = 0
+        # the blockchain route caps at 20 metas per call: page through
+        lo = h0 + 1
+        while lo <= h1:
+            hi = min(lo + 19, h1)
+            metas = clients[0].call("blockchain", min_height=lo,
+                                    max_height=hi)["block_metas"]
+            txs += sum(m["header"]["num_txs"] for m in metas)
+            lo = hi + 1
+        return {
+            "blocks_per_sec": round((h1 - h0) / dt, 2),
+            "txs_per_sec": round(txs / dt, 1),
+            "blocks": h1 - h0,
+            "avg_txs_per_block": round(txs / max(1, h1 - h0), 1),
+            "n_vals": n_vals, "seconds": round(dt, 1),
+            "txs_injected": sent[0],
+            "transport": "tcp sockets, 4 OS processes, secret conns",
+        }
+    finally:
+        stop.set()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
+        import shutil
+        shutil.rmtree(net, ignore_errors=True)
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--socket":
+        r = run_socket()
+        print(json.dumps({
+            "metric": "testnet_socket_commit_rate",
+            "value": r["blocks_per_sec"], "unit": "blocks/sec",
+            "vs_baseline": 0.0, "extra": r,
+        }))
+        return 0
     n_blocks = int(sys.argv[1]) if len(sys.argv) > 1 else 30
     n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     n_txs = int(sys.argv[3]) if len(sys.argv) > 3 else 1000

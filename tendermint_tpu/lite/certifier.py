@@ -140,6 +140,15 @@ class InquiringCertifier:
         self._update_to(fc, depth + 1)
 
 
+def default_window(n_vals: int) -> int:
+    """Headers per pooled dispatch window: sweeps at 16 and 64
+    validators both peak near ~32k signatures in flight (tunnel round
+    trips amortized, chunks fetched in parallel, memory bounded).
+    Exposed so benches can warm the exact tail batch shape a partial
+    chain will dispatch."""
+    return max(64, 32768 // max(1, n_vals))
+
+
 def certify_chain(chain_id: str, fcs: List[FullCommit],
                   trusted: Optional[ValidatorSet] = None,
                   verifier=None, window: Optional[int] = None) -> None:
@@ -167,10 +176,7 @@ def certify_chain(chain_id: str, fcs: List[FullCommit],
         return
     expect_vals = trusted or fcs[0].validators
     if window is None:
-        # sweeps at 16 and 64 validators both peak near ~32k signatures
-        # in flight per window (tunnel round trips amortized, chunks
-        # fetched in parallel, memory still bounded)
-        window = max(64, 32768 // max(1, len(expect_vals)))
+        window = default_window(len(expect_vals))
 
     def collect(window_fcs):
         items_w = []

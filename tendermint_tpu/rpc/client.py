@@ -180,6 +180,17 @@ class WSClient:
 
     # ------------------------------------------------------------------ api
 
+    def cast(self, method: str, **params) -> None:
+        """Fire-and-forget call over the persistent connection: the
+        server's reply is read and dropped by the reader thread. This
+        is the tm-bench load-generation shape — thousands of
+        broadcast_tx casts per second over one socket, no per-call
+        round-trip wait (benchmarks/simu/counter.go's WS spammer)."""
+        self._id += 1
+        self._send_text(json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method,
+             "params": _encode_params(params)}))
+
     def call(self, method: str, timeout: float = 30.0, **params) -> Any:
         self._id += 1
         id_ = self._id
