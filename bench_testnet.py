@@ -156,32 +156,11 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
 
     repo = os.path.dirname(os.path.abspath(__file__))
 
-    def _free_port_block(k):
-        import random
-        for _ in range(50):
-            base = random.randrange(20000, 60000, 2) | 1
-            socks = []
-            try:
-                for off in range(k):
-                    s = _socket.socket()
-                    s.bind(("127.0.0.1", base + off))
-                    socks.append(s)
-                return base
-            except OSError:
-                continue
-            finally:
-                for s in socks:
-                    s.close()
-        raise RuntimeError("no free port block")
-
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.pop("JAX_COMPILATION_CACHE_DIR", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from bench_util import free_port_block, node_child_env
+    env = node_child_env(repo)
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
-    base = _free_port_block(2 * n_vals)
+    base = free_port_block(2 * n_vals)
     subprocess.run(
         [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
          "--n", str(n_vals), "--output", net, "--base-port", str(base),
@@ -204,7 +183,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
 
     procs, logs = [], []
     stop = threading.Event()
-    sent = [0]
+    sent = [0, 0]
     try:
         for i in range(n_vals):
             log = open(os.path.join(net, f"node{i}.log"), "w")
@@ -250,7 +229,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                         ws.cast("broadcast_tx_sync",
                                 tx=(b"s%d.%d=v" % (tid, i)).hex())
                         i += 1
-                        sent[0] += 1
+                    sent[tid] = i  # per-thread slot: no racy +=
                     # periodic sync point: don't outrun the server,
                     # and back off while the backlog is deep enough
                     while not stop.is_set() and ws.call(
@@ -275,8 +254,15 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         # first — the measured window then reaps config-1-shaped
         # (1000-tx) blocks, the sustained-load profile of the
         # reference's atomic_broadcast testnet
+        def check_alive():
+            dead = [i for i, p in enumerate(procs)
+                    if p.poll() is not None]
+            if dead:
+                raise RuntimeError(f"socket-testnet nodes died: {dead}")
+
         deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
+            check_alive()
             try:
                 if clients[0].call("num_unconfirmed_txs")[
                         "n_txs"] >= 2500:
@@ -287,7 +273,10 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
 
         h0 = clients[0].call("status")["latest_block_height"]
         t0 = time.perf_counter()
-        time.sleep(duration_s)
+        end_at = time.monotonic() + duration_s
+        while time.monotonic() < end_at:
+            check_alive()
+            time.sleep(1.0)
         h1 = clients[0].call("status")["latest_block_height"]
         dt = time.perf_counter() - t0
         stop.set()
@@ -306,9 +295,25 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             "blocks": h1 - h0,
             "avg_txs_per_block": round(txs / max(1, h1 - h0), 1),
             "n_vals": n_vals, "seconds": round(dt, 1),
-            "txs_injected": sent[0],
+            "txs_injected": sum(sent),
             "transport": "tcp sockets, 4 OS processes, secret conns",
         }
+    except BaseException:
+        # keep the net tree and surface log tails: the node logs are
+        # the only diagnostics for a boot/run failure
+        for i, log in enumerate(logs):
+            try:
+                log.flush()
+                with open(log.name) as f:
+                    tail = f.read()[-1200:]
+                print(f"--- socknet node{i} log tail ---\n{tail}",
+                      file=sys.stderr)
+            except OSError:
+                pass
+        raise
+    else:
+        import shutil
+        shutil.rmtree(net, ignore_errors=True)
     finally:
         stop.set()
         for p in procs:
@@ -320,8 +325,6 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 p.kill()
         for log in logs:
             log.close()
-        import shutil
-        shutil.rmtree(net, ignore_errors=True)
 
 
 def main() -> int:

@@ -98,3 +98,39 @@ def enable_tpu_compilation_cache(jax_module=None) -> None:
             p in os.environ.get("JAX_PLATFORMS", "")
             for p in ("tpu", "axon")):
         os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+
+
+def free_port_block(k: int) -> int:
+    """A base port with k consecutively-bindable ports (multi-node
+    harnesses need two per node; one busy port in the range reads as a
+    consensus failure). Shared by the socket bench and the e2e tests."""
+    import random
+    import socket
+    for _ in range(50):
+        base = random.randrange(20000, 60000, 2) | 1
+        socks = []
+        try:
+            for off in range(k):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + off))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
+def node_child_env(repo: str) -> dict:
+    """Environment for spawned CPU node processes: strips the axon/TPU
+    markers (children must land on the CPU backend even under the axon
+    sitecustomize) and the CPU-hostile compilation cache."""
+    import os
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env

@@ -194,6 +194,10 @@ def verify_from_bytes_best(pk, rb, s_bytes, h_bytes):
 # on, batches skip decompression entirely via the *_pre kernels.
 
 _PREDECOMP_MAX = 8
+# batches below this padded size skip the cache: one-shot small batches
+# must not pay the extra decompress dispatch (tests lower it to drive
+# the cache logic on already-compiled small shapes)
+_PREDECOMP_MIN_BATCH = 64
 _predecomp: "OrderedDict[bytes, tuple]" = OrderedDict()
 _predecomp_seen: "OrderedDict[bytes, bool]" = OrderedDict()
 # Batched verifies dispatch concurrently (fast-sync collector, lite
@@ -431,7 +435,7 @@ def verify_prepared_async(pk, rb, s_bytes, h_bytes, kernel=None,
     pk_p = _pad_to(pk, m)
     rb_p, sb_p, hb_p = (_pad_to(rb, m), _pad_to(s_bytes, m),
                         _pad_to(h_bytes, m))
-    if kernel is None and m >= 64:
+    if kernel is None and m >= _PREDECOMP_MIN_BATCH:
         # stable-valset fast path: repeated pubkey batches skip point
         # decompression (cache keyed on batch content)
         res = _verify_cached_predecomp(pk_p, rb_p, sb_p, hb_p)

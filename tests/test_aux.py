@@ -66,7 +66,19 @@ home = sys.argv[1]
 from tendermint_tpu.cli import main as cli_main
 if not os.path.exists(os.path.join(home, "config", "genesis.json")):
     cli_main(["--home", home, "init", "--chain-id", "failnet"])
-cli_main(["--home", home, "node", "--max-height", "3",
+# test-speed consensus timeouts: the matrix boots 14 single-node nets,
+# and default timeouts (propose 3000ms, commit 1000ms) would spend
+# ~5s/run idling between its 3 blocks
+import json
+cfgp = os.path.join(home, "config", "config.json")
+cfg = json.load(open(cfgp)) if os.path.exists(cfgp) else {{}}
+cfg.setdefault("consensus", {{}}).update({{
+    "timeout_propose": 300, "timeout_propose_delta": 100,
+    "timeout_prevote": 100, "timeout_prevote_delta": 50,
+    "timeout_precommit": 100, "timeout_precommit_delta": 50,
+    "timeout_commit": 50}})
+json.dump(cfg, open(cfgp, "w"))
+cli_main(["--home", home, "node", "--max-height", "2",
           "--max-seconds", "60"])
 h = 0
 from tendermint_tpu.node import default_node

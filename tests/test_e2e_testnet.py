@@ -17,36 +17,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _free_port_block(k):
-    """A base port such that base..base+k-1 are ALL currently bindable
-    (each node needs two consecutive ports; a single unchecked busy
-    port in the range would look like a consensus failure)."""
-    import random
-    for _ in range(50):
-        base = random.randrange(20000, 60000, 2) | 1
-        socks = []
-        try:
-            for off in range(k):
-                s = socket.socket()
-                s.bind(("127.0.0.1", base + off))
-                socks.append(s)
-            return base
-        except OSError:
-            continue
-        finally:
-            for s in socks:
-                s.close()
-    raise RuntimeError("no free port block found")
+    from bench_util import free_port_block
+    return free_port_block(k)
 
 
 def _node_env():
-    env = dict(os.environ)
-    # children must land on the CPU backend even under the axon
-    # sitecustomize (same dance as __graft_entry__.dryrun_multichip)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.pop("JAX_COMPILATION_CACHE_DIR", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return env
+    from bench_util import node_child_env
+    return node_child_env(REPO)
 
 
 def test_three_process_testnet_atomic_broadcast(tmp_path):

@@ -17,6 +17,9 @@ from tendermint_tpu.utils import ed25519_ref as ref
 
 
 def make_batch(n, salt=b""):
+    # OpenSSL signing (bit-identical to ref.sign, ~1000x faster — the
+    # pure-python ladder costs ~0.5s per signature)
+    from bench_util import fast_signer
     pubs, msgs, sigs = [], [], []
     for i in range(n):
         seed = (i + 7).to_bytes(32, "little")
@@ -24,7 +27,7 @@ def make_batch(n, salt=b""):
         m = b"plk-%d-" % i + salt
         pubs.append(pk)
         msgs.append(m)
-        sigs.append(ref.sign(seed, m))
+        sigs.append(fast_signer(seed)(m))
     return pubs, msgs, sigs
 
 
@@ -46,25 +49,30 @@ def test_pallas_verify_pipeline_one_pass():
     kernel over the identical inputs (the two implementations must agree
     on every lane, valid or not)."""
     pubs, msgs, sigs = make_batch(8)
-    pk, rb, sbits, hbits, pre = ed25519.prepare_batch(pubs, msgs, sigs)
+    pk, rb, s_bytes, h_bytes, pre = ed25519.prepare_batch_bytes(
+        pubs, msgs, sigs)
     assert pre.all()
 
     rng = np.random.RandomState(11)
     pk2 = np.array(pk)
     rb2 = np.array(rb)
-    hb2 = np.array(hbits)
+    hb2 = np.array(h_bytes)
     rb2[1, 0] ^= 0x01                                # targeted R corrupt
     pk2[3] = 0xFF                                    # non-point pubkey
     hb2[5, 0] ^= 1                                   # scalar corrupt
     rb2[6, rng.randint(32)] ^= 1 << rng.randint(8)   # random R flip
     pk2[7, rng.randint(32)] ^= 1 << rng.randint(8)   # random pk flip
 
-    got = run_pallas(pk2, rb2, sbits, hb2)
+    sbits = np.asarray(ed25519._bits_le(s_bytes))
+    hbits2 = np.asarray(ed25519._bits_le(hb2))
+    got = run_pallas(pk2, rb2, sbits, hbits2)
     expect = np.array([1, 0, 1, 0, 1, 0, 0, 0], np.bool_)
     assert (got == expect).all(), got
 
-    want = np.asarray(ed25519.verify_kernel_jit(
-        jnp.asarray(pk2), jnp.asarray(rb2), jnp.asarray(sbits),
+    # bit-identity with the jnp kernel, through the SAME @8 from-bytes
+    # entry the earlier test files already compiled
+    want = np.asarray(ed25519._verify_from_bytes_jnp(
+        jnp.asarray(pk2), jnp.asarray(rb2), jnp.asarray(s_bytes),
         jnp.asarray(hb2)))
     assert (got == want).all(), (got, want)
 
@@ -85,32 +93,17 @@ def test_transposed_byte_roundtrip():
 
 
 def test_sign_kernel_interpret_matches_reference():
-    """enc(r*B) from the pallas sign kernel (interpreter) vs the
-    RFC 8032 reference point arithmetic, plus the full sign_batch host
-    pipeline (phase1 nonce, device R, phase2 finalize) cross-checked
-    against scalar OpenSSL signatures via monkeypatched device."""
-    import numpy as np
-
-    from tendermint_tpu.ops import ed25519, ladder_pallas
-    from tendermint_tpu.utils import ed25519_ref as ref
-
-    rng = np.random.default_rng(9)
-    n = 8
-    rs = [int.from_bytes(bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
-                         "little") % ed25519.L_ORDER for _ in range(n)]
-    r_u8 = np.zeros((n, 32), np.uint8)
-    for i, r in enumerate(rs):
-        r_u8[i] = np.frombuffer(r.to_bytes(32, "little"), np.uint8)
-    enc = np.asarray(ladder_pallas.sign_pallas_rB(
-        jnp.asarray(r_u8), tile=8, interpret=True))
-    for i, r in enumerate(rs):
-        want = ref.point_compress(ref.point_mul(r, ref.BASE))
-        assert enc[i].tobytes() == want, i
-
-    # full pipeline differential: route the device step through the
-    # interpreter and compare finished signatures with OpenSSL
+    """The full sign_batch pipeline (native phase1 nonce, pallas-
+    interpreted R = r*B, native phase2 finalize) must produce
+    signatures byte-identical to scalar OpenSSL. ONE interpreter
+    invocation covers everything: sig[:32] equality pins the kernel's
+    enc(r*B) output (the nonce r is deterministic per RFC 8032), and
+    sig[32:] pins the host k/s finalization."""
     from cryptography.hazmat.primitives.asymmetric.ed25519 import \
         Ed25519PrivateKey
+
+    from tendermint_tpu.ops import ed25519, ladder_pallas
+
     seeds = [bytes([i + 1] * 32) for i in range(8)]
     msgs = [b"sign-batch-%d" % i * (i + 1) for i in range(8)]
     orig_pallas = ed25519._pallas_available
