@@ -167,6 +167,7 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
     warm_s = 0.0
     timed_s = 0.0
     best_wave = 0.0
+    wave_rates = []
     done = 0
     while done < n_headers:
         tb = time.perf_counter()
@@ -187,19 +188,23 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
         sig_seeds = [seeds[idx_of[j]]
                      for _ in range(n_w) for j in range(n_vals)]
         sig_msgs = [m for m in msgs for _ in range(n_vals)]
-        sigs = ed.sign_batch(sig_seeds, sig_msgs)
+        # dispatch signing, then build the vote/commit objects WHILE
+        # the device computes R = r*B — signatures attach at resolve
+        resolver = ed.sign_batch_async(sig_seeds, sig_msgs)
         fcs = []
+        all_votes = []
         for i, h in enumerate(heights):
             precommits = [None] * n_vals
-            base = i * n_vals
             for j, val in enumerate(vals):
                 v = Vote(val.address, j, h, 0, h, VoteType.PRECOMMIT,
                          bids[i])
-                v.signature = sigs[base + j]
                 precommits[j] = v
+                all_votes.append(v)
             fcs.append(FullCommit(
                 SignedHeader(headers[i], Commit(bids[i], precommits),
                              bids[i]), valset))
+        for v, sig in zip(all_votes, resolver()):
+            v.signature = sig
         build_s += time.perf_counter() - tb
 
         if done == 0:
@@ -217,10 +222,16 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
         dt = time.perf_counter() - tw
         timed_s += dt
         best_wave = max(best_wave, n_w / dt)
+        wave_rates.append(n_w / dt)
         done += n_w
+    wave_rates.sort()
     return {
         "headers_per_sec": round(done / timed_s, 1),
         "best_wave_headers_per_sec": round(best_wave, 1),
+        # a 1M-header run spans ~25 min of shared-tunnel load swings;
+        # the median wave separates capability from transient load
+        "median_wave_headers_per_sec": round(
+            wave_rates[len(wave_rates) // 2], 1),
         "headers": done, "vals_per_header": n_vals,
         "waves": (done + wave - 1) // wave, "wave_headers": wave,
         "sig_verifies_per_sec": round(done * n_vals / timed_s, 1),

@@ -182,8 +182,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         _json.dump(cfg, open(cfg_path, "w"))
 
     procs, logs = [], []
+    n_spammers = 2
     stop = threading.Event()
-    sent = [0, 0]
+    sent = [0] * n_spammers
     try:
         for i in range(n_vals):
             log = open(os.path.join(net, f"node{i}.log"), "w")
@@ -246,7 +247,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                     time.sleep(0.2)
 
         spammers = [threading.Thread(target=spam, args=(t,), daemon=True)
-                    for t in range(2)]
+                    for t in range(n_spammers)]
         for t in spammers:
             t.start()
         # pre-fill: HTTP injection (~500 tx/s on this shared core) is
@@ -289,6 +290,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                                     max_height=hi)["block_metas"]
             txs += sum(m["header"]["num_txs"] for m in metas)
             lo = hi + 1
+        import shutil
+        shutil.rmtree(net, ignore_errors=True)
         return {
             "blocks_per_sec": round((h1 - h0) / dt, 2),
             "txs_per_sec": round(txs / dt, 1),
@@ -311,9 +314,6 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             except OSError:
                 pass
         raise
-    else:
-        import shutil
-        shutil.rmtree(net, ignore_errors=True)
     finally:
         stop.set()
         for p in procs:

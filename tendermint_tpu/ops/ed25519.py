@@ -323,14 +323,16 @@ def _sign_rb_pallas(r_u8):
     return ladder_pallas.sign_pallas_rB(r_u8)
 
 
-def sign_batch(seeds, msgs) -> list:
-    """Batched Ed25519 signing: aligned seeds[i] signs msgs[i].
-    Returns 64-byte signatures, byte-identical to scalar RFC 8032 /
-    OpenSSL output. Device path needs a TPU (pallas) + the native
-    extension; anything else falls back to per-item scalar signing."""
+def sign_batch_async(seeds, msgs):
+    """Dispatch batched signing WITHOUT blocking: returns a zero-arg
+    resolver yielding the signature list. The nonce hashes run now
+    (native, GIL released); the device R = r*B chunks are enqueued; the
+    resolver fetches them (parallel, round trips overlapped) and
+    finalizes s = r + k*a natively — a chain builder constructs its
+    header/vote objects while the device works."""
     n = len(msgs)
     if n == 0:
-        return []
+        return lambda: []
     from tendermint_tpu import native
     mod = native._prep()
     if mod is None or not hasattr(mod, "sign_phase1") or \
@@ -347,9 +349,9 @@ def sign_batch(seeds, msgs) -> list:
                     s = Ed25519PrivateKey.from_private_bytes(seed).sign
                     signers[seed] = s
                 out.append(s(m))
-            return out
         except ImportError:  # pragma: no cover
-            return [ref.sign(seed, m) for seed, m in zip(seeds, msgs)]
+            out = [ref.sign(seed, m) for seed, m in zip(seeds, msgs)]
+        return lambda: out
     params = [signing_params(seed) for seed in seeds]
     a_cat = b"".join(p[0] for p in params)
     pre_cat = b"".join(p[1] for p in params)
@@ -367,19 +369,31 @@ def sign_batch(seeds, msgs) -> list:
         m = 512 * ((hi - lo + 511) // 512)
         pending.append((hi - lo, _sign_rb_pallas(
             jnp.asarray(_pad_to(r_np[lo:hi], m)))))
-    if len(pending) > 1:
-        # tunneled links execute at fetch: parallel fetches overlap the
-        # per-chunk round trips (same pattern as the verifier resolve)
-        from tendermint_tpu.models.verifier import _fetch_pool_get
-        arrs = list(_fetch_pool_get().map(
-            lambda p: np.asarray(p[1]), pending))
-    else:
-        arrs = [np.asarray(pending[0][1])]
-    renc_cat = np.concatenate(
-        [a[:real] for (real, _), a in zip(pending, arrs)],
-        axis=0).tobytes()
-    sig_cat = mod.sign_phase2(renc_cat, pk_cat, msgs, r_cat, a_cat)
-    return [sig_cat[64 * i:64 * (i + 1)] for i in range(n)]
+
+    def resolve() -> list:
+        if len(pending) > 1:
+            # tunneled links execute at fetch: parallel fetches overlap
+            # the per-chunk round trips (same as the verifier resolve)
+            from tendermint_tpu.models.verifier import _fetch_pool_get
+            arrs = list(_fetch_pool_get().map(
+                lambda p: np.asarray(p[1]), pending))
+        else:
+            arrs = [np.asarray(pending[0][1])]
+        renc_cat = np.concatenate(
+            [a[:real] for (real, _), a in zip(pending, arrs)],
+            axis=0).tobytes()
+        sig_cat = mod.sign_phase2(renc_cat, pk_cat, msgs, r_cat, a_cat)
+        return [sig_cat[64 * i:64 * (i + 1)] for i in range(n)]
+
+    return resolve
+
+
+def sign_batch(seeds, msgs) -> list:
+    """Batched Ed25519 signing: aligned seeds[i] signs msgs[i].
+    Returns 64-byte signatures, byte-identical to scalar RFC 8032 /
+    OpenSSL output. Device path needs a TPU (pallas) + the native
+    extension; anything else falls back to per-item scalar signing."""
+    return sign_batch_async(seeds, msgs)()
 
 
 # ---------------------------------------------------------------------------

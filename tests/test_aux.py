@@ -68,7 +68,7 @@ if not os.path.exists(os.path.join(home, "config", "genesis.json")):
     cli_main(["--home", home, "init", "--chain-id", "failnet"])
 # test-speed consensus timeouts: the matrix boots 14 single-node nets,
 # and default timeouts (propose 3000ms, commit 1000ms) would spend
-# ~5s/run idling between its 3 blocks
+# ~5s/run idling between its blocks
 import json
 cfgp = os.path.join(home, "config", "config.json")
 cfg = json.load(open(cfgp)) if os.path.exists(cfgp) else {{}}
