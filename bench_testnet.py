@@ -182,6 +182,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         _json.dump(cfg, open(cfg_path, "w"))
 
     procs, logs = [], []
+    cleanup_ok = [False]
     n_spammers = 2
     stop = threading.Event()
     sent = [0] * n_spammers
@@ -290,8 +291,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                                     max_height=hi)["block_metas"]
             txs += sum(m["header"]["num_txs"] for m in metas)
             lo = hi + 1
-        import shutil
-        shutil.rmtree(net, ignore_errors=True)
+        cleanup_ok[0] = True
         return {
             "blocks_per_sec": round((h1 - h0) / dt, 2),
             "txs_per_sec": round(txs / dt, 1),
@@ -325,6 +325,11 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 p.kill()
         for log in logs:
             log.close()
+        if cleanup_ok[0]:
+            # only after every node process is down and logs are
+            # closed: rmtree must not race live writers
+            import shutil
+            shutil.rmtree(net, ignore_errors=True)
 
 
 def main() -> int:

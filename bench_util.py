@@ -103,11 +103,17 @@ def enable_tpu_compilation_cache(jax_module=None) -> None:
 def free_port_block(k: int) -> int:
     """A base port with k consecutively-bindable ports (multi-node
     harnesses need two per node; one busy port in the range reads as a
-    consensus failure). Shared by the socket bench and the e2e tests."""
+    consensus failure). Shared by the socket bench and the e2e tests.
+
+    Ports come from BELOW the kernel's ephemeral range (32768-60999 on
+    this host): the probe-then-bind window is seconds long, and an
+    outgoing connection's auto-assigned source port can steal a probed
+    ephemeral-range port in between — the flaky 'Address already in
+    use' node-boot failure."""
     import random
     import socket
     for _ in range(50):
-        base = random.randrange(20000, 60000, 2) | 1
+        base = random.randrange(20000, 32000, 2) | 1
         socks = []
         try:
             for off in range(k):
