@@ -264,7 +264,7 @@ def cmd_replay(args, console: bool = False) -> int:
     (consensus/replay_file.go:32 RunReplayFile). --console pauses for
     ENTER between messages and accepts 'quit'."""
     from tendermint_tpu.config import default_config
-    from tendermint_tpu.consensus.replay import catchup_replay
+    from tendermint_tpu.consensus.replay import replay_messages, wal_tail_for
     from tendermint_tpu.node import Node
     from tendermint_tpu.types import GenesisDoc
 
@@ -274,26 +274,36 @@ def cmd_replay(args, console: bool = False) -> int:
     node = Node(config, gen_doc, priv_validator=None)
     cs, wal = node.consensus, node.wal
     height = cs.state.last_block_height
-    tail = wal.messages_after_end_height(height)
+    # same tail selection as node-start catchup (incl. the legacy
+    # genesis fallback) so this debugging tool reproduces the node
+    from tendermint_tpu.storage import WALCorruptionError
+    try:
+        tail = wal_tail_for(wal, height)
+    except (ValueError, WALCorruptionError) as e:
+        print(f"cannot replay: {e}")
+        node.stop()
+        return 1
     if tail is None:
         print(f"WAL has no messages after height {height}")
+        node.stop()
         return 1
-    cs.replay_mode = True
-    n = 0
-    for m in tail:
-        msg = dict(m.msg)
-        peer = msg.pop("peer", "")
-        if msg.get("type") in ("round_state", "endheight"):
-            continue
+
+    def before_submit(msg):
         if console:
             cmdline = input(
                 f"> next: {msg.get('type')} (ENTER to apply, q to quit) ")
             if cmdline.strip().lower() in ("q", "quit"):
-                break
-        cs.submit(msg, peer_id=peer)
-        n += 1
+                return False
+        return True
+
+    def after_submit(msg):
         print(f"replayed {msg.get('type')} -> "
               f"H/R/S {cs.rs.height}/{cs.rs.round}/{int(cs.rs.step)}")
+
+    # the feed loop itself is replay_messages — the SAME code node
+    # startup runs, so what this tool shows is what recovery does
+    n = replay_messages(cs, tail, before_submit=before_submit,
+                        after_submit=after_submit)
     print(f"replayed {n} messages; final height {cs.rs.height}")
     node.stop()
     return 0

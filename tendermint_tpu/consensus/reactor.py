@@ -150,8 +150,10 @@ class ConsensusReactor(Reactor):
         self.fast_sync = False
         try:
             catchup_replay(self.cs, self.cs.wal)
-        except ValueError:
-            pass  # fresh WAL, or fast-sync advanced past its last height
+        except ValueError as e:
+            # fast-sync routinely advances past the WAL's last marker —
+            # benign, but log it so a genuinely lost marker is visible
+            self.cs.logger.info("WAL catchup replay skipped", err=str(e))
         # announce ourselves: peers held back gossip while our PeerState
         # was unknown; this round-step kicks it off
         if self.switch is not None:

@@ -31,15 +31,44 @@ class HandshakeError(Exception):
     pass
 
 
-def catchup_replay(cs, wal) -> int:
-    """Replay WAL messages after ENDHEIGHT(height-1) into ConsensusState.
-    Returns number of messages replayed."""
-    height = cs.state.last_block_height
+def wal_tail_for(wal, height: int) -> Optional[list]:
+    """The WAL messages to re-feed for a node whose state is at
+    `height`: everything after `#ENDHEIGHT height`. None = nothing to
+    replay (fresh chain). Raises ValueError when the marker is missing
+    for a height the state claims to have committed.
+
+    Marker absent at genesis: fresh WALs write `#ENDHEIGHT 0` on
+    creation, but a log recorded before that rule (or whose marker frame
+    was torn away) may still hold height-1 messages — and a node at
+    state-height 0 has never committed, so such a log IS height 1's
+    tail. Replay it all rather than strand the validator's own signed
+    votes. Guard: any `endheight > 0` marker proves the log spans
+    committed heights the state has lost (e.g. a wiped state DB) — that
+    inconsistency must surface, not be replayed into genesis state."""
     tail = wal.messages_after_end_height(height)
-    if tail is None:
-        if height == 0:
-            return 0  # fresh chain, nothing to replay
+    if tail is not None:
+        return tail  # may be [] — marker found, clean shutdown
+    if height != 0:
         raise ValueError(f"WAL has no #ENDHEIGHT for {height}")
+    msgs = wal.all_messages()
+    if not msgs:
+        return None
+    for m in msgs:
+        if m.msg.get("type") == "endheight" and m.msg.get("height", 0) > 0:
+            raise ValueError(
+                "WAL spans committed heights but state is at 0 "
+                "(state store wiped?) — refusing genesis replay")
+    return msgs
+
+
+def replay_messages(cs, tail, before_submit=None, after_submit=None) -> int:
+    """Feed WAL messages through the state machine's normal handle path
+    with replay-mode side effects suppressed. ONE definition shared by
+    node-start catchup and the `replay[_console]` CLI so the debug tool
+    can never drift from real node recovery. `before_submit(msg)` (the
+    console's pause hook) may return False to stop early;
+    `after_submit(msg)` is the console's progress print. Returns the
+    number of messages submitted."""
     cs.replay_mode = True
     try:
         n = 0
@@ -48,11 +77,25 @@ def catchup_replay(cs, wal) -> int:
             peer = msg.pop("peer", "")
             if msg.get("type") in ("round_state", "endheight"):
                 continue
+            if before_submit is not None and before_submit(msg) is False:
+                break
             cs.submit(msg, peer_id=peer)
             n += 1
+            if after_submit is not None:
+                after_submit(msg)
         return n
     finally:
         cs.replay_mode = False
+
+
+def catchup_replay(cs, wal) -> int:
+    """Replay WAL messages after ENDHEIGHT(height-1) into ConsensusState.
+    Returns number of messages replayed."""
+    height = cs.state.last_block_height
+    tail = wal_tail_for(wal, height)
+    if tail is None:
+        return 0  # fresh chain, nothing to replay
+    return replay_messages(cs, tail)
 
 
 class Handshaker:

@@ -212,8 +212,11 @@ class Node:
         if not self.fast_sync:
             try:
                 catchup_replay(self.consensus, self.wal)
-            except ValueError:
-                pass  # empty/fresh WAL
+            except ValueError as e:
+                # missing marker for a committed height / multi-height
+                # WAL over genesis state: not fatal (the node proceeds
+                # without replay, same as before) but must be visible
+                self.logger.error("WAL catchup replay skipped", err=str(e))
 
         if self.switch is not None:
             host, port = _parse_laddr(self.config.p2p.laddr)

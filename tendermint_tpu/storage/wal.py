@@ -64,22 +64,38 @@ def encode_frame(m: WALMessage) -> bytes:
 def decode_frames(data: bytes, tolerate_truncated_tail: bool = True
                   ) -> Iterator[WALMessage]:
     """Decode frames; raises WALCorruptionError on CRC/length mismatch.
-    A truncated final frame (crash mid-write) is dropped silently."""
+    A truncated final frame (crash or snapshot mid-write) is dropped
+    silently — but only when it really is FINAL: if a CRC-valid frame
+    chain resumes after the undecodable region, the "truncation" is a
+    corrupt length field shadowing good frames (an append-only writer
+    can never put complete frames after a partial one), and dropping
+    them silently is exactly the data loss this layer must refuse."""
     off = 0
     n = len(data)
+
+    def tail_or_raise(what: str):
+        if not tolerate_truncated_tail:
+            raise WALCorruptionError(what)
+        if _buffer_resyncs(data, off, n):
+            raise WALCorruptionError(
+                f"{what} but valid frames resume after it "
+                "(corrupt length field?)")
+
     while off < n:
         if off + _HEADER.size > n:
-            if tolerate_truncated_tail:
-                return
-            raise WALCorruptionError("truncated frame header")
+            tail_or_raise("truncated frame header")
+            return
         crc, length = _HEADER.unpack_from(data, off)
+        if crc == 0 and length == 0:
+            # zero-filled tail block (power loss): torn, not a frame
+            tail_or_raise("zero-filled tail")
+            return
         if length > _MAX_FRAME:
             raise WALCorruptionError(f"frame length {length} too large")
         start = off + _HEADER.size
         if start + length > n:
-            if tolerate_truncated_tail:
-                return
-            raise WALCorruptionError("truncated frame payload")
+            tail_or_raise("truncated frame payload")
+            return
         payload = data[start:start + length]
         if zlib.crc32(payload) & 0xFFFFFFFF != crc:
             raise WALCorruptionError("crc mismatch")
@@ -90,6 +106,102 @@ def decode_frames(data: bytes, tolerate_truncated_tail: bool = True
         off = start + length
 
 
+def _trim_torn_tail(path: str) -> None:
+    """Truncate an incomplete final frame (crash mid-write) from the WAL
+    head at open time, so frames appended afterwards stay reachable —
+    decode_frames stops at the first truncated frame, so appending past
+    a torn tail would silently hide everything after it. Only an
+    EOF-truncated frame is trimmed; a full frame with a bad CRC or an
+    oversized length is real corruption and still raises at read time.
+
+    Distinguishing torn from corrupt: a mid-file bit-flip in a LENGTH
+    field can make a good frame's interior look like a frame extending
+    past EOF — truncating there would silently destroy the valid frames
+    after it. A genuinely torn tail is the cut-short suffix of ONE
+    frame write, so no valid frame chain can resume after the torn
+    point; if one does (CRC-verified to EOF, a 2^-32 false-positive per
+    candidate offset), the file is corrupt, not torn, and is left
+    byte-identical for the reader to reject loudly."""
+    if not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    off = 0
+    torn = False
+    with open(path, "rb") as f:
+        # pass 1 — headers only, payloads skipped with seek, so a clean
+        # restart never buffers the whole (up to rotate_bytes) head
+        while off < size:
+            if off + _HEADER.size > size:
+                torn = True
+                break
+            hdr = f.read(_HEADER.size)
+            crc, length = _HEADER.unpack(hdr)
+            if crc == 0 and length == 0:
+                # all-zero header: filesystem zero-fill of the torn tail
+                # block (power loss), not a frame — real frames always
+                # carry a payload. Trim from here.
+                torn = True
+                break
+            if length > _MAX_FRAME:
+                break  # corrupt, not torn: leave for the reader to reject
+            if off + _HEADER.size + length > size:
+                torn = True
+                break
+            off += _HEADER.size + length
+            f.seek(off)
+        if torn and off < size:
+            # pass 2 (rare, crash recovery only): prefix must CRC-clean
+            # and no frame chain may resync after the torn point
+            f.seek(0)
+            pos = 0
+            while pos < off:
+                crc, length = _HEADER.unpack(f.read(_HEADER.size))
+                if zlib.crc32(f.read(length)) & 0xFFFFFFFF != crc:
+                    return  # corrupt prefix: reader will reject loudly
+                pos += _HEADER.size + length
+            if _frame_chain_resyncs(f, off, size):
+                return  # corrupt length field, not a torn write
+    if torn and off < size:
+        os.truncate(path, off)
+
+
+def _buffer_resyncs(buf, start: int, end: int) -> bool:
+    """True if ANY complete CRC-valid frame starts in (start, end) —
+    evidence that bytes after `start` are real frames shadowed by
+    corruption, not the remains of one torn write (an append-only
+    writer cannot put a complete frame after a partial one). ONE valid
+    frame suffices: requiring a chain to reach EOF would dismiss a
+    resumed chain that itself ends in a second torn tail, and the
+    failure directions are asymmetric — a false positive (a random
+    window CRC-validating, ~2^-32 per candidate) refuses a trim and
+    fails loudly; a false negative truncates committed frames silently.
+    Zero-length frames are excluded: crc32(b"") == 0, so filesystem
+    zero-fill of torn tail blocks would "validate", and a real frame
+    always carries a JSON payload."""
+    for cand in range(start + 1, end - _HEADER.size + 1):
+        crc, length = _HEADER.unpack_from(buf, cand)
+        if (length == 0 or length > _MAX_FRAME
+                or cand + _HEADER.size + length > end):
+            continue
+        payload = bytes(buf[cand + _HEADER.size:
+                            cand + _HEADER.size + length])
+        if zlib.crc32(payload) & 0xFFFFFFFF == crc:
+            return True
+    return False
+
+
+def _frame_chain_resyncs(f, start: int, size: int) -> bool:
+    """File wrapper over _buffer_resyncs. The region is < _MAX_FRAME +
+    header (pass 1 bounds it), so it is scanned in memory — a
+    per-offset seek/read loop would cost millions of file-object calls
+    on a near-_MAX_FRAME torn frame."""
+    f.seek(start)
+    buf = f.read(size - start)
+    return _buffer_resyncs(buf, 0, len(buf))
+
+
 class WAL:
     def __init__(self, path: str, rotate_bytes: int = 64 << 20,
                  max_backups: int = 16, light: bool = False):
@@ -98,7 +210,22 @@ class WAL:
         self.max_backups = max_backups
         self.light = light  # light mode skips peer messages (wal.go:121-128)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        _trim_torn_tail(path)
         self._f = open(path, "ab")
+        # A fresh WAL starts with `#ENDHEIGHT 0` (consensus/wal.go:99-104):
+        # without it, a node that crashes during its FIRST height has no
+        # marker for messages_after_end_height(0) to anchor on, catchup
+        # replay silently finds nothing, and the restarted validator
+        # stalls — double-sign protection (correctly) refuses to re-sign
+        # height 1, but the votes it already cast are stranded in the WAL.
+        # "Fresh" = head is EMPTY (zero bytes, possibly after trimming a
+        # torn frame — NOT merely undecodable: a corrupt head must stay
+        # byte-identical for the operator until the reader rejects it
+        # loudly) AND no rotated backups (a restart that lands on a
+        # just-rotated empty head must not plant a second height-0
+        # marker mid-log).
+        if self._f.tell() == 0 and not os.path.exists(f"{path}.1"):
+            self.save_end_height(0)
 
     # -- writing -------------------------------------------------------------
 

@@ -132,5 +132,6 @@ def test_wal_rejects_oversized_frame_at_write_time(tmp_path):
     wal.save({"type": "ok"})
     wal.close()
     wal2 = WAL(str(tmp_path / "wal"))
-    assert [m.msg["type"] for m in wal2.all_messages()] == ["ok"]
+    assert [m.msg["type"] for m in wal2.all_messages()] == \
+        ["endheight", "ok"]
     wal2.close()

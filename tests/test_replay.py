@@ -131,3 +131,32 @@ def test_wal_generator_produces_replayable_wal(tmp_path):
     assert msgs, "no messages after ENDHEIGHT(2)"
     types = {m.msg.get("type") for m in msgs}
     assert "vote" in types and "proposal" in types
+
+
+# ------------------------------------------------- genesis tail fallback --
+
+def test_wal_tail_for_legacy_genesis_log(tmp_path):
+    """A pre-marker-era WAL (height-1 messages, no #ENDHEIGHT at all)
+    must still yield its whole log as height 1's tail at state-height 0
+    — but a log whose markers prove committed heights over a wiped
+    state store must refuse loudly instead of replaying into genesis."""
+    from tendermint_tpu.consensus.replay import wal_tail_for
+    from tendermint_tpu.storage.wal import WAL, encode_frame, WALMessage
+
+    # legacy log: write raw frames (no creation marker)
+    legacy = str(tmp_path / "legacy.wal")
+    with open(legacy, "wb") as f:
+        f.write(encode_frame(WALMessage(0, {"type": "proposal", "h": 1})))
+        f.write(encode_frame(WALMessage(0, {"type": "vote", "h": 1})))
+    tail = wal_tail_for(WAL(legacy), 0)
+    assert [m.msg["type"] for m in tail] == ["proposal", "vote"]
+
+    # multi-height log over genesis state: must raise, not replay
+    multi = str(tmp_path / "multi.wal")
+    with open(multi, "wb") as f:
+        f.write(encode_frame(WALMessage(0, {"type": "vote", "h": 1})))
+        f.write(encode_frame(WALMessage(
+            0, {"type": "endheight", "height": 1})))
+        f.write(encode_frame(WALMessage(0, {"type": "vote", "h": 2})))
+    with pytest.raises(ValueError, match="state store wiped"):
+        wal_tail_for(WAL(multi), 0)

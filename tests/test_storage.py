@@ -203,7 +203,26 @@ def test_wal_roundtrip_and_endheight_search(tmp_path):
     tail = wal2.messages_after_end_height(1)
     assert [m.msg["type"] for m in tail] == ["proposal", "vote"]
     assert wal2.messages_after_end_height(7) is None
-    assert len(wal2.all_messages()) == 4
+    # 4 saved + the ENDHEIGHT-0 marker a fresh WAL writes on creation
+    assert len(wal2.all_messages()) == 5
+    wal2.close()
+
+
+def test_fresh_wal_has_endheight_zero(tmp_path):
+    """A brand-new WAL must anchor catchup replay for the FIRST height
+    (consensus/wal.go:99-104): a validator that crashes mid-height-1
+    finds its own proposal/votes via messages_after_end_height(0); with
+    no marker the tail is None, replay is skipped, and double-sign
+    protection strands the node (the fail-point-index-1 stall)."""
+    wal = WAL(str(tmp_path / "wal"))
+    assert wal.messages_after_end_height(0) == []
+    wal.save({"type": "vote", "h": 1})
+    wal.close()
+    wal2 = WAL(str(tmp_path / "wal"))  # reopen must not re-write it
+    msgs = wal2.all_messages()
+    assert [m.msg["type"] for m in msgs] == ["endheight", "vote"]
+    assert [m.msg["type"] for m in wal2.messages_after_end_height(0)] == \
+        ["vote"]
     wal2.close()
 
 
@@ -219,7 +238,137 @@ def test_wal_truncated_tail_is_tolerated(tmp_path):
         f.write(data[:-3])
     wal2 = WAL(path)
     msgs = wal2.all_messages()
-    assert [m.msg["type"] for m in msgs] == ["a"]
+    assert [m.msg["type"] for m in msgs] == ["endheight", "a"]
+    wal2.close()
+
+
+def test_wal_appends_after_torn_tail_stay_readable(tmp_path):
+    """A crash mid-write leaves a torn final frame; reopening must trim
+    it so frames appended afterwards remain decodable (decode_frames
+    stops at the first truncated frame, so appending past a torn tail
+    would silently hide everything after it)."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.save({"type": "a"})
+    wal.save({"type": "b"})
+    wal.close()
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:  # crash mid-write of frame "b"
+        f.write(data[:-3])
+    wal2 = WAL(path)
+    wal2.save({"type": "c"})  # append after the (trimmed) torn tail
+    wal2.close()
+    wal3 = WAL(path)
+    assert [m.msg["type"] for m in wal3.all_messages()] == \
+        ["endheight", "a", "c"]
+    wal3.close()
+
+
+def test_wal_torn_initial_marker_rewritten(tmp_path):
+    """If the crash tore the very first frame (the ENDHEIGHT-0 marker
+    itself), reopen trims to empty and re-plants the marker."""
+    path = str(tmp_path / "wal")
+    WAL(path).close()
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:5])  # partial header only
+    wal = WAL(path)
+    assert wal.messages_after_end_height(0) == []
+    wal.close()
+
+
+def test_wal_zero_filled_tail_is_trimmed(tmp_path):
+    """Power loss classically extends the file to a block boundary and
+    zero-fills the tail. Zero bytes must read as torn garbage (8 zero
+    bytes 'CRC-validate' because crc32(b'')==0), be trimmed at open,
+    and never veto the trim as fake 'resync' evidence."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.save({"type": "a"})
+    wal.save({"type": "b"})
+    wal.close()
+    good = open(path, "rb").read()
+    # a torn write is a PREFIX of a valid frame; build one from a real
+    # frame ("c") so its header length points past the zeros/EOF
+    frame_c = encode_frame(WALMessage(0, {"type": "c", "pad": "y" * 48}))
+    for tail in (b"\x00" * 24,                    # aligned zero run
+                 b"\x00" * 13,                    # ragged zero run
+                 frame_c[:12],                    # classic torn write
+                 frame_c[:12] + b"\x00" * 16):    # torn write + zero fill
+        with open(path, "wb") as f:
+            f.write(good + tail)
+        wal2 = WAL(path)
+        assert [m.msg["type"] for m in wal2.all_messages()] == \
+            ["endheight", "a", "b"], tail
+        wal2.save({"type": "c"})  # appends land after the trim point
+        wal2.close()
+        wal3 = WAL(path)
+        assert [m.msg["type"] for m in wal3.all_messages()] == \
+            ["endheight", "a", "b", "c"], tail
+        wal3.close()
+
+
+def test_wal_midfile_length_corruption_not_trimmed(tmp_path):
+    """A bit-flipped LENGTH field mid-file makes a good frame look like
+    it extends past EOF (i.e. torn). Open-time repair must notice the
+    valid frames that resume after it and leave the file byte-identical
+    — truncating would silently destroy committed consensus messages."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.save({"type": "a"})
+    wal.save({"type": "b", "pad": "x" * 40})
+    wal.save({"type": "c"})
+    wal.close()
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # find frame "b"'s header: walk one frame (endheight) + one ("a")
+    import struct
+    off = 0
+    for _ in range(2):
+        _, ln = struct.unpack_from(">II", data, off)
+        off += 8 + ln
+    crc_b, ln_b = struct.unpack_from(">II", data, off)
+    struct.pack_into(">II", data, off, crc_b, ln_b + 64)  # past EOF
+    with open(path, "wb") as f:
+        f.write(data)
+    wal2 = WAL(path)  # reopen triggers the repair scan
+    with open(path, "rb") as f:
+        assert f.read() == bytes(data), "corrupt WAL was mutated"
+    # and reading must reject loudly, NOT silently drop frames b and c
+    # as a "tolerated truncated tail"
+    with pytest.raises(WALCorruptionError, match="resume after"):
+        wal2.all_messages()
+    wal2.close()
+
+    # same corruption PLUS a genuinely torn final frame: the resumed
+    # b->c chain no longer reaches EOF, but one valid frame after the
+    # corruption is still proof — must refuse the trim and read loudly
+    frame_d = encode_frame(WALMessage(0, {"type": "d"}))
+    data_torn = bytes(data) + frame_d[:11]
+    with open(path, "wb") as f:
+        f.write(data_torn)
+    wal3 = WAL(path)
+    with open(path, "rb") as f:
+        assert f.read() == data_torn, "corrupt+torn WAL was mutated"
+    with pytest.raises(WALCorruptionError, match="resume after"):
+        wal3.all_messages()
+    wal3.close()
+
+
+def test_wal_rotated_empty_head_gets_no_spurious_marker(tmp_path):
+    """Restarting on a just-rotated (empty) head file must NOT write a
+    second ENDHEIGHT-0 marker into the middle of the logical log."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path, rotate_bytes=1)  # every save rotates
+    wal.save_end_height(3)
+    wal.close()
+    assert os.path.getsize(path) == 0 and os.path.exists(path + ".1")
+    wal2 = WAL(path, rotate_bytes=1)
+    types = [m.msg for m in wal2.all_messages()]
+    assert types[-1] == {"type": "endheight", "height": 3}
+    assert wal2.messages_after_end_height(3) == []
     wal2.close()
 
 
