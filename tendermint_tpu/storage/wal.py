@@ -18,6 +18,7 @@ matching the reference's autofile group semantics (consensus/wal.go:152).
 from __future__ import annotations
 
 import os
+import re
 import struct
 import zlib
 from dataclasses import dataclass
@@ -26,6 +27,7 @@ from typing import Iterator, Optional
 from tendermint_tpu.types import encoding
 
 _HEADER = struct.Struct(">II")
+_NONZERO = re.compile(rb"[^\x00]")
 _MAX_FRAME = 2 << 20  # generous: a message is at most one block part + meta
 
 
@@ -180,15 +182,26 @@ def _buffer_resyncs(buf, start: int, end: int) -> bool:
     Zero-length frames are excluded: crc32(b"") == 0, so filesystem
     zero-fill of torn tail blocks would "validate", and a real frame
     always carries a JSON payload."""
-    for cand in range(start + 1, end - _HEADER.size + 1):
+    cand = start + 1
+    while cand <= end - _HEADER.size:
         crc, length = _HEADER.unpack_from(buf, cand)
-        if (length == 0 or length > _MAX_FRAME
-                or cand + _HEADER.size + length > end):
+        if length == 0:
+            # A valid header needs a nonzero length field, so nothing
+            # inside a zero run can start a frame — jump to 7 bytes
+            # before the next nonzero byte (C-level scan: a zero-filled
+            # region can span tens of MB and a per-byte Python loop
+            # would stall node startup for seconds).
+            m = _NONZERO.search(buf, cand + _HEADER.size)
+            if m is None:
+                return False
+            cand = max(cand + 1, m.start() - (_HEADER.size - 1))
             continue
-        payload = bytes(buf[cand + _HEADER.size:
-                            cand + _HEADER.size + length])
-        if zlib.crc32(payload) & 0xFFFFFFFF == crc:
-            return True
+        if length <= _MAX_FRAME and cand + _HEADER.size + length <= end:
+            payload = bytes(buf[cand + _HEADER.size:
+                                cand + _HEADER.size + length])
+            if zlib.crc32(payload) & 0xFFFFFFFF == crc:
+                return True
+        cand += 1
     return False
 
 

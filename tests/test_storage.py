@@ -310,6 +310,26 @@ def test_wal_zero_filled_tail_is_trimmed(tmp_path):
         wal3.close()
 
 
+def test_wal_large_zero_tail_trims_fast(tmp_path):
+    """A multi-MB zero-filled tail (fallocate/journal zero-extension)
+    must trim in well under a second: the resync scan jumps zero runs
+    with a C-level search instead of a per-byte Python loop."""
+    import time
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.save({"type": "a"})
+    wal.close()
+    with open(path, "ab") as f:
+        f.write(b"\x00" * (8 << 20))
+    t0 = time.perf_counter()
+    wal2 = WAL(path)
+    took = time.perf_counter() - t0
+    assert [m.msg["type"] for m in wal2.all_messages()] == \
+        ["endheight", "a"]
+    wal2.close()
+    assert took < 1.0, f"zero-tail repair took {took:.2f}s"
+
+
 def test_wal_midfile_length_corruption_not_trimmed(tmp_path):
     """A bit-flipped LENGTH field mid-file makes a good frame look like
     it extends past EOF (i.e. torn). Open-time repair must notice the
