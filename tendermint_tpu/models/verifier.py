@@ -65,8 +65,16 @@ def _fetch_pool_get():
     with _mesh_lock:
         if _fetch_pool is None:
             from concurrent.futures import ThreadPoolExecutor
+            # 8 workers: a deeply pipelined caller (fast-sync windows,
+            # bench at 8 commits in flight) resolves 2 chunks per
+            # 10k-sig batch — 4 workers serialized 16 concurrent chunk
+            # fetches and capped sustained throughput ~30% below the
+            # 8-worker rate (tunnel sweep, 2026-08-01). Threads are
+            # idle-cheap; TM_TPU_FETCH_WORKERS overrides.
             _fetch_pool = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="tm-verify-fetch")
+                max_workers=int(os.environ.get(
+                    "TM_TPU_FETCH_WORKERS", "8")),
+                thread_name_prefix="tm-verify-fetch")
         return _fetch_pool
 
 
