@@ -249,7 +249,12 @@ def main() -> int:
         rounds = []  # each round's OWN best, so the log shows whether
         #              later rounds escaped congestion or got worse
         threshold = quiet_ms[tag] * m / 10240
-        for rnd in range(4):
+        # the thresholds are calibrated for the default 10k commit;
+        # a smaller manual `bench.py N` is tunnel-RTT-bound (~60-110ms
+        # floor) and would never hit a down-scaled threshold — run the
+        # plain single round there instead of 3 futile 20s retries
+        n_rounds = 4 if m >= 10240 else 1
+        for rnd in range(n_rounds):
             dt_round = float("inf")
             for i in range(trials if rnd == 0 else 6):
                 if i:
@@ -264,7 +269,7 @@ def main() -> int:
             rounds.append(round(dt_round * 1e3, 2))
             if dt_best * 1e3 <= threshold:
                 break
-            if rnd < 3:
+            if rnd < n_rounds - 1:
                 time.sleep(20.0)  # wait out the congestion burst
         trial_log[tag] = rounds
         return dt_best
