@@ -63,6 +63,31 @@ def test_secret_connection_roundtrip_and_identity():
     a.close(); b.close()
 
 
+def test_secret_connection_parallel_writers():
+    """Reference parity (p2p/conn/secret_connection_test.go parallel
+    read/write): concurrent writers on one SecretConnection must not
+    interleave nonce order — AEAD would fail loudly at the reader on
+    any desync, and every message must arrive intact exactly once."""
+    a, b, _, _ = make_secret_pair()
+    n_writers, per = 4, 50
+    sent = [f"w{w}-m{i}".encode() for w in range(n_writers)
+            for i in range(per)]
+
+    def writer(w):
+        for i in range(per):
+            a.write(f"w{w}-m{i}".encode())
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_writers)]
+    for t in threads:
+        t.start()
+    got = [b.read() for _ in range(n_writers * per)]
+    for t in threads:
+        t.join(10)
+    assert sorted(got) == sorted(sent)
+    a.close(); b.close()
+
+
 def test_secret_connection_ciphertext_not_plaintext():
     s1, s2 = socket.socketpair()
     nk1 = NodeKey(PrivKey.generate(b"\x01" * 32))
