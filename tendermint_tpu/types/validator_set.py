@@ -67,7 +67,8 @@ class ValidatorSet:
     """Sorted-by-address validator array with accum-based proposer rotation
     (types/validator_set.go:24-71)."""
 
-    def __init__(self, validators: Sequence[Validator]):
+    def __init__(self, validators: Sequence[Validator],
+                 _fresh: bool = True):
         self.validators: List[Validator] = sorted(
             (v.copy() for v in validators), key=lambda v: v.address)
         addrs = [v.address for v in self.validators]
@@ -81,6 +82,15 @@ class ValidatorSet:
         self._index = {a: i for i, a in enumerate(addrs)}
         self._proposer: Optional[Validator] = None
         self._hash: Optional[bytes] = None
+        # NewValidatorSet parity (types/validator_set.go:33-48): a FRESH
+        # set runs one accum increment, so the first proposer is the
+        # highest-power validator, not the lowest address. Deserialized
+        # sets (from_obj) and update_with_changes suppress this — they
+        # carry accums mid-rotation, exactly like the reference's
+        # reflect-deserialization and Add/Update/Remove paths, where the
+        # per-block increment happens in ApplyBlock instead.
+        if _fresh and self.validators:
+            self.increment_accum(1)
 
     def __len__(self) -> int:
         return len(self.validators)
@@ -114,14 +124,23 @@ class ValidatorSet:
     # -- proposer rotation (types/validator_set.go:51-71) ------------------
 
     def increment_accum(self, times: int = 1) -> None:
+        """Advance proposer rotation by `times` rounds — reference-exact
+        (types/validator_set.go:51-71): power*times lands on every accum
+        ONCE, then the running maximum is decremented `times` times (the
+        last pick is the proposer). Decrement-per-step over freshly
+        re-added power picks DIFFERENT proposers for times > 1, which is
+        a live round-skip (consensus enter_new_round jumping rounds)."""
+        if not self.validators or times <= 0:
+            return
+        for v in self.validators:
+            v.accum += v.voting_power * times
+        total = self.total_voting_power()
         for _ in range(times):
-            for v in self.validators:
-                v.accum += v.voting_power
             mostest = self.validators[0]
             for v in self.validators[1:]:
                 mostest = mostest.compare_accum(v)
-            mostest.accum -= self.total_voting_power()
-            self._proposer = mostest
+            mostest.accum -= total
+        self._proposer = mostest
 
     def proposer(self) -> Validator:
         if self._proposer is None:
@@ -147,11 +166,30 @@ class ValidatorSet:
         return self._hash
 
     def to_obj(self):
-        return {"validators": [v.to_obj() for v in self.validators]}
+        o = {"validators": [v.to_obj() for v in self.validators]}
+        # The proposer is STATE, not derivable from accums: after an
+        # increment the proposer is the pre-decrement maximum, which the
+        # post-decrement accums no longer identify. The reference
+        # persists its Proposer field via reflect for the same reason —
+        # without it, a restarted node computes a different proposer
+        # than its live peers and stalls its first post-restart height.
+        if self._proposer is not None:
+            o["proposer"] = self._proposer.address.hex()
+        return o
 
     @classmethod
     def from_obj(cls, o):
-        vs = cls([Validator.from_obj(v) for v in o["validators"]])
+        vs = cls([Validator.from_obj(v) for v in o["validators"]],
+                 _fresh=False)
+        prop = o.get("proposer")
+        if prop is not None:
+            i = vs._index.get(bytes.fromhex(prop), -1)
+            if i < 0:
+                # inconsistent persisted state: failing loudly beats
+                # silently deriving a proposer live peers won't agree on
+                raise ValueError(
+                    f"proposer {prop} not in validator set")
+            vs._proposer = vs.validators[i]
         return vs
 
     # -- commit verification: THE batched hot path --------------------------
@@ -314,4 +352,7 @@ class ValidatorSet:
                 by_addr[c.address] = Validator(c.pubkey, c.voting_power, accum)
         if not by_addr:
             raise ValueError("validator set would be empty")
-        return ValidatorSet(list(by_addr.values()))
+        # _fresh=False: accums carry over mid-rotation (the reference's
+        # Add/Update/Remove invalidate Proposer but never re-increment;
+        # ApplyBlock's own increment_accum(1) follows separately)
+        return ValidatorSet(list(by_addr.values()), _fresh=False)
