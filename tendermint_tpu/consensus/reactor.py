@@ -283,8 +283,16 @@ class ConsensusReactor(Reactor):
                 with self.cs._lock:
                     rs = self.cs.rs
                     if rs.height == msg["height"] and rs.votes is not None:
-                        rs.votes.set_peer_maj23(
-                            msg["round"], msg["vote_type"], peer.id, bid)
+                        try:
+                            rs.votes.set_peer_maj23(
+                                msg["round"], msg["vote_type"], peer.id, bid)
+                        except ValueError as e:
+                            # conflicting claim from the same peer: the
+                            # reference discards the error without
+                            # dropping the peer (consensus/reactor.go
+                            # ignores SetPeerMaj23's return)
+                            self.cs.logger.info(
+                                "bad maj23 claim", peer=peer.id, err=str(e))
                         vs = (rs.votes.prevotes(msg["round"])
                               if msg["vote_type"] == VoteType.PREVOTE
                               else rs.votes.precommits(msg["round"]))

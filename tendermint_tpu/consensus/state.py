@@ -698,16 +698,20 @@ class ConsensusState:
         try:
             self._add_vote(vote, peer_id)
         except ConflictingVoteError as e:
-            if self.priv_validator is not None and \
-                    vote.validator_address == self.priv_validator.address:
-                self._log("conflicting vote from ourselves!")
-                return
-            ev = DuplicateVoteEvidence(
-                pubkey=self._pubkey_of(vote.validator_address),
-                vote_a=e.existing, vote_b=e.new)
-            self.evidence_pool.add_evidence(ev)
+            self._file_duplicate_vote_evidence(vote, e)
         except ValueError as e:
             self._log(f"bad vote from {peer_id!r}: {e}")
+
+    def _file_duplicate_vote_evidence(self, vote: Vote,
+                                      e: ConflictingVoteError) -> None:
+        if self.priv_validator is not None and \
+                vote.validator_address == self.priv_validator.address:
+            self._log("conflicting vote from ourselves!")
+            return
+        ev = DuplicateVoteEvidence(
+            pubkey=self._pubkey_of(vote.validator_address),
+            vote_a=e.existing, vote_b=e.new)
+        self.evidence_pool.add_evidence(ev)
 
     def _pubkey_of(self, addr: bytes) -> bytes:
         _, val = self.rs.validators.get_by_address(addr)
@@ -723,7 +727,14 @@ class ConsensusState:
                 return
             if rs.last_commit is None:
                 return
-            if rs.last_commit.add_vote(vote):
+            try:
+                added_lc = rs.last_commit.add_vote(vote)
+            except ConflictingVoteError as e:
+                # same (added, err) pairing as the current-height path:
+                # a counted conflicting straggler must still publish
+                self._file_duplicate_vote_evidence(vote, e)
+                added_lc = e.added
+            if added_lc:
                 self._publish_vote(vote)
                 if self.config.skip_timeout_commit and \
                         rs.last_commit.has_all():
@@ -737,7 +748,19 @@ class ConsensusState:
             return  # height mismatch: ignore
 
         height = rs.height
-        added = rs.votes.add_vote(vote, peer_id)
+        try:
+            added = rs.votes.add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            # The reference's AddVote returns (added, err) TOGETHER: a
+            # conflicting vote for a peer-claimed maj23 block is counted
+            # AND reported. File the evidence here, then — when it was
+            # counted — fall through to the normal quorum-driven
+            # transitions below; swallowing it would leave a formed +2/3
+            # unacted-on until an unrelated timeout (stalls the height).
+            self._file_duplicate_vote_evidence(vote, e)
+            if not e.added:
+                return
+            added = True
         if not added:
             return
         self._publish_vote(vote)

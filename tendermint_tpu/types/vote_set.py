@@ -19,10 +19,16 @@ from tendermint_tpu.types.vote import Vote, VoteType
 
 
 class ConflictingVoteError(Exception):
-    def __init__(self, existing: Vote, new: Vote):
+    """`added` mirrors the reference AddVote's (added, err) pair: a
+    conflicting vote for a peer-claimed maj23 block is COUNTED and
+    still reported — the caller must both file evidence AND run its
+    normal post-add transitions (quorum checks, publish) when added."""
+
+    def __init__(self, existing: Vote, new: Vote, added: bool = False):
         super().__init__(f"conflicting vote: {existing} vs {new}")
         self.existing = existing
         self.new = new
+        self.added = added
 
 
 @dataclass
@@ -66,7 +72,10 @@ class VoteSet:
         the batch: per-vote failures (invalid signature, conflict) are
         returned as (position, error) pairs while every other vote is still
         applied — matching the reference's per-vote AddVote error
-        semantics (types/vote_set.go:130)."""
+        semantics (types/vote_set.go:130). A conflicting vote counted via
+        a peer-claimed maj23 block appears in BOTH lists: results[pos] is
+        True (it mutated the set, possibly crossing quorum) AND its
+        ConflictingVoteError (added=True) is in errors."""
         errors: List[tuple[int, Exception]] = []
         results = self._add_votes(votes, errors)
         return results, errors
@@ -104,9 +113,18 @@ class VoteSet:
             except Exception as e:
                 fail(pos, e)
                 continue
+            # duplicate detection mirrors the reference's getVote
+            # (types/vote_set.go:202-216): a vote may live in the
+            # canonical slot OR only in a tracked block's votesByBlock
+            # (an admitted conflicting vote) — a regossiped copy of
+            # either is a silent no-op, not a fresh conflict to re-file
+            # evidence (and re-run crypto) for.
             existing = self.votes[idx]
             if existing is not None and existing.block_id == vote.block_id:
                 continue  # duplicate; results[pos] stays False
+            bv0 = self.votes_by_block.get(vote.block_id.key())
+            if bv0 is not None and idx in bv0.votes_by_index:
+                continue  # already counted for this block (conflict path)
             # (on conflict: still verify the signature before accusing)
             to_verify.append((vote, val, pos))
 
@@ -120,45 +138,90 @@ class VoteSet:
             try:
                 results[pos] = self._add_verified(vote, val)
             except ConflictingVoteError as e:
+                # e.added: the vote WAS counted (peer-claimed maj23
+                # block) — the result must say applied even though the
+                # conflict is also reported, or a batch caller skips
+                # the quorum transitions the vote may have triggered
+                results[pos] = e.added
                 fail(pos, e)
         return results
 
     def _add_verified(self, vote: Vote, val) -> bool:
-        """types/vote_set.go:219-287: record by block, track conflicts,
-        detect quorum crossing."""
+        """types/vote_set.go:219-287 addVerifiedVote, exactly:
+
+        - A conflicting vote still COUNTS toward a block some peer
+          claims +2/3 for (set_peer_maj23) — without this, one
+          equivocating validator's first vote could permanently hide
+          the real majority from us. It is counted AND reported
+          (ConflictingVoteError raised after the bookkeeping, the
+          reference's `return true, conflicting`).
+        - A conflicting vote for an UNTRACKED block is dropped (raised
+          without counting).
+        - When a tracked block crosses quorum, its votes become the
+          canonical per-validator votes — equivocators' maj23-block
+          votes replace their first votes (vote_set.go:273-283).
+        """
         idx = vote.validator_index
         existing = self.votes[idx]
+        conflicting = None
         if existing is not None and existing.block_id != vote.block_id:
-            raise ConflictingVoteError(existing, vote)
+            conflicting = existing
+            # replace the canonical slot only if this block IS the maj23
+            if self.maj23 is not None and \
+                    self.maj23.key() == vote.block_id.key():
+                self.votes[idx] = vote
+        elif existing is None:
+            self.votes[idx] = vote
+            self.power += val.voting_power
 
         key = vote.block_id.key()
         bv = self.votes_by_block.get(key)
-        if bv is None:
-            bv = _BlockVotes(peer_maj23=key in {b.key() for b in self.peer_maj23s.values()})
+        if bv is not None:
+            if conflicting is not None and not bv.peer_maj23:
+                raise ConflictingVoteError(existing, vote)  # not counted
+        else:
+            if conflicting is not None:
+                # untracked block + conflict: just forget it
+                raise ConflictingVoteError(existing, vote)
+            bv = _BlockVotes(peer_maj23=False)
             self.votes_by_block[key] = bv
         if idx in bv.votes_by_index:
+            if conflicting is not None:
+                raise ConflictingVoteError(existing, vote)
             return False
+        orig = bv.power
         bv.votes_by_index[idx] = vote
         bv.power += val.voting_power
-        if existing is None:
-            self.votes[idx] = vote
-            self.power += val.voting_power
         quorum = self.valset.total_voting_power() * 2 // 3 + 1
-        if bv.power >= quorum and self.maj23 is None:
+        if orig < quorum <= bv.power and self.maj23 is None:
             self.maj23 = vote.block_id
+            for i, v in bv.votes_by_index.items():
+                self.votes[i] = v
+        if conflicting is not None:
+            # counted + reported (the reference's `return true, conflicting`)
+            raise ConflictingVoteError(existing, vote, added=True)
         return True
 
     # -- peer-claimed majorities --------------------------------------------
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
-        """A peer claims +2/3 for block_id (types/vote_set.go:294)."""
+        """A peer claims +2/3 for block_id (types/vote_set.go:294-329).
+        The block starts being TRACKED immediately (entry created even
+        before any vote arrives) so later conflicting votes for it are
+        admitted. A conflicting claim from the same peer raises — the
+        reference returns an error there; callers log it."""
         prev = self.peer_maj23s.get(peer_id)
-        if prev is not None and prev != block_id:
+        if prev is not None:
+            if prev == block_id:
+                return
             raise ValueError(f"conflicting maj23 claims from peer {peer_id}")
         self.peer_maj23s[peer_id] = block_id
         bv = self.votes_by_block.get(block_id.key())
         if bv is not None:
             bv.peer_maj23 = True
+        else:
+            self.votes_by_block[block_id.key()] = \
+                _BlockVotes(peer_maj23=True)
 
     # -- queries -------------------------------------------------------------
 

@@ -364,3 +364,102 @@ def test_bad_proposal_rejected_and_prevotes_nil():
     v = prevotes[0]["vote"]
     assert v["block_id"]["hash"] == "", \
         f"prevoted the invalid block: {v['block_id']}"
+
+
+def test_conflicting_precommit_for_claimed_maj23_block_commits():
+    """types/vote_set.go:219-287 + AddVote's (added, err) pair, driven
+    through the full state machine: after a peer claims +2/3 for block
+    B (vote-set-maj23), an equivocating validator's CONFLICTING
+    precommit for B both files DuplicateVoteEvidence and — because it
+    was counted — tips the quorum, so the node must enter commit
+    immediately rather than sit on a formed +2/3 until a timeout."""
+    from tendermint_tpu.types.block import BlockID, Commit
+    from tendermint_tpu.types.proposal import Proposal
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    nodes, keys = make_net(4, chain_id="maj23-test")
+    for n in nodes:
+        n.broadcast_hooks.clear()
+    prop_addr = nodes[0].rs.validators.proposer().address
+    prop_idx = next(i for i, k in enumerate(keys)
+                    if k.pubkey.address == prop_addr)
+    victim_idx = next(i for i in range(4) if i != prop_idx)
+    victim = nodes[victim_idx]
+    sent = []
+    victim.broadcast_hooks.append(
+        lambda m: sent.append(m) if m.get("type") == "vote" else None)
+    victim.start()
+
+    block = victim.state.make_block(1, [b"tx=1"], Commit(), time_ns=10 ** 9)
+    parts = block.make_part_set(
+        victim.state.consensus_params.block_gossip.block_part_size_bytes)
+    prop = Proposal(1, 0, parts.header(), timestamp_ns=5)
+    prop.signature = keys[prop_idx].sign(prop.sign_bytes("maj23-test"))
+    victim.submit({"type": "proposal", "proposal": prop.to_obj()},
+                  peer_id="peerX")
+    for i in range(parts.total):
+        victim.submit({"type": "block_part", "height": 1, "round": 0,
+                       "part": parts.get_part(i).to_obj()}, peer_id="peerX")
+    for _ in range(20):
+        if any(m["vote"]["type"] == VoteType.PREVOTE for m in sent):
+            break
+        victim.ticker.fire_next()
+    my_prevote = next(m for m in sent
+                      if m["vote"]["type"] == VoteType.PREVOTE)
+    bid = BlockID.from_obj(my_prevote["vote"]["block_id"])
+    assert bid.hash == block.hash(), "victim did not prevote the block"
+
+    def vote_from(key, type_, vbid, ts):
+        i, _ = victim.rs.validators.get_by_address(key.pubkey.address)
+        v = Vote(key.pubkey.address, i, 1, 0, ts, type_, vbid)
+        v.signature = key.sign(v.sign_bytes("maj23-test"))
+        return {"type": "vote", "vote": v.to_obj()}
+
+    others = [k for i, k in enumerate(keys) if i != victim_idx]
+    honest1, honest2, equivocator = others
+    nil_bid = BlockID(b"", bid.parts.__class__(0, b""))
+
+    # polka: two honest prevotes for B -> victim precommits B
+    victim.submit(vote_from(honest1, VoteType.PREVOTE, bid, 11), "p1")
+    victim.submit(vote_from(honest2, VoteType.PREVOTE, bid, 12), "p2")
+    for _ in range(20):
+        if any(m["vote"]["type"] == VoteType.PRECOMMIT for m in sent):
+            break
+        victim.ticker.fire_next()
+    assert any(m["vote"]["type"] == VoteType.PRECOMMIT and
+               m["vote"]["block_id"]["hash"] == bid.hash.hex()
+               for m in sent), "victim did not precommit the block"
+
+    # one honest precommit for B (2 of 4 power), equivocator NIL (first vote)
+    victim.submit(vote_from(honest1, VoteType.PRECOMMIT, bid, 21), "p1")
+    victim.submit(vote_from(equivocator, VoteType.PRECOMMIT, nil_bid, 22),
+                  "p3")
+    assert victim.state.last_block_height == 0  # no quorum yet
+
+    # record evidence (make_node wires a MockEvidencePool that drops it)
+    filed = []
+
+    class RecordingPool:
+        def add_evidence(self, ev):
+            filed.append(ev)
+
+        def pending_evidence(self):
+            return []
+
+        def update(self, block, state=None):
+            pass
+    victim.evidence_pool = RecordingPool()
+
+    # a peer claims +2/3 for B; then the equivocator's CONFLICTING
+    # precommit for B arrives and must tip the commit
+    victim.rs.votes.set_peer_maj23(0, VoteType.PRECOMMIT, "peerZ", bid)
+    victim.submit(vote_from(equivocator, VoteType.PRECOMMIT, bid, 23), "p4")
+    for _ in range(20):
+        if victim.state.last_block_height >= 1:
+            break
+        victim.ticker.fire_next()
+    assert victim.state.last_block_height >= 1, (
+        "formed +2/3 was not acted on: conflicting-but-counted vote "
+        "did not trigger commit")
+    assert filed, "equivocation produced no evidence"
+    assert filed[0].vote_a.block_id != filed[0].vote_b.block_id

@@ -719,3 +719,101 @@ def test_proposer_survives_serialization_roundtrip():
         vs.increment_accum(1)
         vs2.increment_accum(1)
         assert vs2.proposer().address == vs.proposer().address
+
+
+def test_vote_set_majority_keys_on_full_block_id():
+    """types/vote_set_test.go:159 Test2_3MajorityRedux: the quorum is
+    keyed on the FULL BlockID — votes for the same hash but a different
+    PartSetHeader hash or total are DIFFERENT blocks and must never pool
+    into one majority. 100 validators: 66 for the block, then one nil,
+    one wrong parts-hash, one wrong parts-total, one wrong hash (still
+    no 2/3); the 71st correct vote tips it."""
+    vs, privs = make_valset(100)
+    bid = BlockID(hash=b"R".ljust(32, b"\1"),
+                  parts=PartSetHeader(123, b"q" * 32))
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs, verifier=PYV)
+    for i in range(66):
+        assert vset.add_vote(
+            signed_vote(privs[i], i, 1, 0, VoteType.PREVOTE, bid))
+    assert vset.two_thirds_majority() is None
+
+    variants = [
+        BlockID(b"", PartSetHeader(0, b"")),                    # nil
+        BlockID(bid.hash, PartSetHeader(123, b"z" * 32)),       # parts hash
+        BlockID(bid.hash, PartSetHeader(124, bid.parts.hash)),  # parts total
+        BlockID(b"X".ljust(32, b"\2"), bid.parts),              # block hash
+    ]
+    for j, vbid in enumerate(variants):
+        i = 66 + j
+        assert vset.add_vote(
+            signed_vote(privs[i], i, 1, 0, VoteType.PREVOTE, vbid))
+        assert vset.two_thirds_majority() is None, vbid
+
+    assert vset.add_vote(
+        signed_vote(privs[70], 70, 1, 0, VoteType.PREVOTE, bid))
+    maj = vset.two_thirds_majority()
+    assert maj == bid
+    assert maj.parts.total == 123 and maj.parts.hash == b"q" * 32
+
+
+def test_vote_set_conflicts_with_peer_maj23_tracking():
+    """types/vote_set_test.go:318 TestConflicts, end to end: conflicting
+    votes are dropped for untracked blocks, ADMITTED (counted AND
+    reported) for blocks a peer claims +2/3 for, a same-peer conflicting
+    claim is rejected without state change, and admitted conflicting
+    votes carry the tracked block across quorum."""
+    from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+    vs, privs = make_valset(4)
+    nil_bid = BlockID(b"", PartSetHeader(0, b""))
+    bid1 = BlockID(b"one".ljust(32, b"\1"), PartSetHeader(0, b""))
+    bid2 = BlockID(b"two".ljust(32, b"\2"), PartSetHeader(0, b""))
+    vset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs, verifier=PYV)
+
+    # val0 votes nil, then conflictingly for bid1 (untracked): dropped
+    assert vset.add_vote(signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE,
+                                     nil_bid))
+    with pytest.raises(ConflictingVoteError):
+        vset.add_vote(signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, bid1))
+    assert vset.bit_array_by_block_id(bid1) is None or \
+        not any(vset.bit_array_by_block_id(bid1))
+
+    # peerA claims +2/3 for bid1: val0's conflicting re-vote now COUNTS
+    vset.set_peer_maj23("peerA", bid1)
+    with pytest.raises(ConflictingVoteError):
+        vset.add_vote(signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, bid1))
+    assert any(vset.bit_array_by_block_id(bid1))
+
+    # peerA cannot switch claims; bid2 stays untracked for conflicts
+    with pytest.raises(ValueError):
+        vset.set_peer_maj23("peerA", bid2)
+    with pytest.raises(ConflictingVoteError):
+        vset.add_vote(signed_vote(privs[0], 0, 1, 0, VoteType.PREVOTE, bid2))
+
+    # val1 -> bid1 (clean); no majority yet, not even 2/3 "any"
+    assert vset.add_vote(signed_vote(privs[1], 1, 1, 0, VoteType.PREVOTE,
+                                     bid1))
+    assert not vset.has_two_thirds_majority()
+    assert not vset.has_two_thirds_any()
+
+    # val2 -> bid2 (clean): 2/3 "any" but no block majority
+    assert vset.add_vote(signed_vote(privs[2], 2, 1, 0, VoteType.PREVOTE,
+                                     bid2))
+    assert not vset.has_two_thirds_majority()
+    assert vset.has_two_thirds_any()
+
+    # peerB claims bid1; val2's conflicting bid1 vote is admitted and
+    # tips bid1 over quorum: val0(conflict) + val1 + val2(conflict)
+    vset.set_peer_maj23("peerB", bid1)
+    with pytest.raises(ConflictingVoteError) as exc:
+        vset.add_vote(signed_vote(privs[2], 2, 1, 0, VoteType.PREVOTE, bid1))
+    assert exc.value.added, "counted conflict must report added=True"
+    assert vset.has_two_thirds_majority()
+    assert vset.two_thirds_majority() == bid1
+    assert vset.has_two_thirds_any()
+
+    # a REGOSSIPED copy of the counted conflicting vote is a silent
+    # duplicate (reference getVote, types/vote_set.go:202-216) — no
+    # fresh ConflictingVoteError, no evidence re-filing, no crypto
+    assert vset.add_vote(
+        signed_vote(privs[2], 2, 1, 0, VoteType.PREVOTE, bid1)) is False
