@@ -463,3 +463,57 @@ def test_conflicting_precommit_for_claimed_maj23_block_commits():
         "did not trigger commit")
     assert filed, "equivocation produced no evidence"
     assert filed[0].vote_a.block_id != filed[0].vote_b.block_id
+
+
+def test_proposer_rotates_across_heights():
+    """consensus/state_test.go:58 TestStateProposerSelection0: with
+    equal powers the height-h round-0 proposer is validators[(h-1) % n]
+    in address order — the constructor increment gives height 1 to
+    position 0 and ApplyBlock's per-block increment advances it."""
+    nodes, _ = make_net(4, chain_id="rot-test")
+    for n in nodes:
+        n.start()
+    run_until_height(nodes, 3)
+    for n in nodes:
+        vs = n.rs.validators
+        expect = vs.validators[(n.rs.height - 1) % 4].address
+        assert vs.proposer().address == expect, (
+            f"height {n.rs.height}: wrong proposer")
+
+
+def test_proposer_rotates_per_round_on_nil_votes():
+    """consensus/state_test.go:92 TestStateProposerSelection2: every
+    nil round hands the proposer role to the next validator in address
+    order (equal powers) — round r of height 1 belongs to position
+    r % n."""
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    nodes, keys = make_net(4, chain_id="rot2-test")
+    for n in nodes:
+        n.broadcast_hooks.clear()
+    victim = nodes[0]
+    victim.start()
+    nil_bid = BlockID(b"", PartSetHeader(0, b""))
+    my_addr = victim.priv_validator.address
+
+    for r in range(4):
+        assert victim.rs.round == r
+        vs = victim.rs.validators
+        assert vs.proposer().address == vs.validators[r % 4].address, (
+            f"round {r}: wrong proposer")
+        for k in keys:
+            if k.pubkey.address == my_addr:
+                continue
+            i, _val = vs.get_by_address(k.pubkey.address)
+            for t, ts in ((VoteType.PREVOTE, 100 + r),
+                          (VoteType.PRECOMMIT, 200 + r)):
+                v = Vote(k.pubkey.address, i, 1, r, ts, t, nil_bid)
+                v.signature = k.sign(v.sign_bytes("rot2-test"))
+                victim.submit({"type": "vote", "vote": v.to_obj()},
+                              peer_id="px")
+        for _ in range(30):
+            if victim.rs.round > r:
+                break
+            victim.ticker.fire_next()
+        assert victim.rs.round == r + 1, f"stuck in round {r}"
