@@ -196,9 +196,9 @@ class KVStoreApp(BaseApplication):
         if self._core is not None and txs:
             out = self._kvmod.deliver_batch(self._core, txs)
             if isinstance(out, tuple):
-                keys, packed = out
+                n, packed = out
                 self.tx_count += len(txs)
-                return UniformDeliverResults(keys, packed=packed)
+                return UniformDeliverResults(None, packed=packed, n=n)
         return [self.deliver_tx(tx) for tx in txs]
 
     def commit(self) -> bytes:

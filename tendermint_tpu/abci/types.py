@@ -102,29 +102,45 @@ class UniformDeliverResults:
     `uniform = True` is the protocol marker results_hash and
     ABCIResponses.to_obj key their fast paths on."""
 
-    __slots__ = ("keys", "code", "data", "log", "tag_key", "_packed")
+    __slots__ = ("_keys", "code", "data", "log", "tag_key", "_packed",
+                 "_n")
     uniform = True
 
     def __init__(self, keys, code: int = CodeTypeOK, data: bytes = b"",
                  log: str = "", tag_key: str = "app.key",
-                 packed: bytes = None):
-        self.keys = keys
+                 packed: bytes = None, n: int = None):
+        # keys may be None when `packed` (the length-prefixed key blob
+        # from the native core) and `n` are given: the per-key bytes
+        # objects then only materialize if a per-tx consumer asks
+        self._keys = keys
         self.code = code
         self.data = data
         self.log = log
         self.tag_key = tag_key
         self._packed = packed  # length-prefixed key blob, if prebuilt
+        self._n = len(keys) if keys is not None else n
+
+    @property
+    def keys(self):
+        if self._keys is None:
+            blob, pos, keys = self._packed, 0, []
+            for _ in range(self._n):
+                ln = int.from_bytes(blob[pos:pos + 4], "little")
+                keys.append(blob[pos + 4:pos + 4 + ln])
+                pos += 4 + ln
+            self._keys = keys
+        return self._keys
 
     def __len__(self):
-        return len(self.keys)
+        return self._n
 
     def __iter__(self):
-        for i in range(len(self.keys)):
+        for i in range(self._n):
             yield self[i]
 
     def __getitem__(self, i):
         if isinstance(i, slice):
-            return [self[j] for j in range(*i.indices(len(self.keys)))]
+            return [self[j] for j in range(*i.indices(self._n))]
         return ResultDeliverTx(
             self.code, self.data, self.log,
             {self.tag_key: self.keys[i].decode("utf-8", "replace")})
@@ -138,19 +154,17 @@ class UniformDeliverResults:
                 len(k).to_bytes(4, "little") + k for k in self.keys)
         return {"code": self.code, "data": self.data.hex(),
                 "log": self.log, "tag_key": self.tag_key,
-                "n": len(self.keys), "keys_packed": packed.hex()}
+                "n": self._n, "keys_packed": packed.hex()}
 
     @classmethod
     def from_compact_obj(cls, o: dict) -> "UniformDeliverResults":
         if "keys_packed" in o:
-            blob = bytes.fromhex(o["keys_packed"])
-            keys, pos = [], 0
-            for _ in range(o["n"]):
-                ln = int.from_bytes(blob[pos:pos + 4], "little")
-                keys.append(blob[pos + 4:pos + 4 + ln])
-                pos += 4 + ln
-        else:  # older persisted form: per-key hex list
-            keys = [bytes.fromhex(k) for k in o["keys"]]
+            # stays lazy: keys unpack from the blob only if a per-tx
+            # consumer asks (the keys property)
+            return cls(None, o["code"], bytes.fromhex(o["data"]),
+                       o["log"], o["tag_key"],
+                       packed=bytes.fromhex(o["keys_packed"]), n=o["n"])
+        keys = [bytes.fromhex(k) for k in o["keys"]]  # older form
         return cls(keys, o["code"], bytes.fromhex(o["data"]), o["log"],
                    o["tag_key"])
 

@@ -64,6 +64,9 @@ def _load():
         lib.tm_merkle_proof.argtypes = [u8p, u64p, ctypes.c_uint64,
                                         ctypes.c_uint64, u8p, u8p]
         lib.tm_merkle_proof.restype = ctypes.c_uint64
+        lib.tm_merkle_tree_proofs.argtypes = [u8p, u64p, ctypes.c_uint64,
+                                              u8p, u8p]
+        lib.tm_merkle_tree_proofs.restype = ctypes.c_uint64
         lib.tm_ed25519_prepare.argtypes = [u8p, u8p, u8p, u64p,
                                            ctypes.c_uint64, u8p, u8p]
         _lib = lib
@@ -316,6 +319,28 @@ def ed25519_prepare(pk_bytes: bytes, sig_bytes: bytes,
     h = np.frombuffer(bytes(h_out), np.uint8).reshape(n, 32).copy()
     pre = np.frombuffer(bytes(pre_out), np.uint8)[:n].astype(bool).copy()
     return h, pre
+
+
+def merkle_tree_proofs(items: List[bytes]):
+    """(root, [aunts per item]) from ONE tree build — the part-set
+    constructor needs every item's proof; per-item merkle_proof calls
+    rebuilt the tree once per part. None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(items)
+    depth_max = max(1, (max(n, 1) - 1).bit_length())
+    buf, offsets = _pack(items)
+    out_root = (ctypes.c_uint8 * 32)()
+    out_aunts = (ctypes.c_uint8 * (32 * depth_max * max(1, n)))()
+    depth = lib.tm_merkle_tree_proofs(buf, offsets, n, out_root, out_aunts)
+    raw = bytes(out_aunts)
+    proofs = []
+    for i in range(n):
+        base = 32 * depth * i  # C packs proofs at the actual depth
+        proofs.append([raw[base + 32 * j:base + 32 * (j + 1)]
+                       for j in range(depth)])
+    return bytes(out_root), proofs
 
 
 def merkle_proof(items: List[bytes], index: int):

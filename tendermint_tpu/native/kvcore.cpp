@@ -31,7 +31,8 @@
 #include <array>
 #include <string>
 #include <string_view>
-#include <unordered_map>
+#include <mutex>
+#include <vector>
 
 #include "hostops.cpp"
 
@@ -94,9 +95,20 @@ struct Acc256 {
 
 inline void pair_digest(std::string_view k, std::string_view v,
                         uint8_t out[32]) {
+    uint32_t kl = (uint32_t)k.size(), vl = (uint32_t)v.size();
+    size_t total = 8 + k.size() + v.size();
+    if (total <= 55) {  // typical kv tx: one padded block, one compress
+        uint8_t msg[55];
+        for (int i = 0; i < 4; i++) msg[i] = uint8_t(kl >> (8 * i));
+        std::memcpy(msg + 4, k.data(), k.size());
+        uint8_t *p = msg + 4 + k.size();
+        for (int i = 0; i < 4; i++) p[i] = uint8_t(vl >> (8 * i));
+        std::memcpy(p + 4, v.data(), v.size());
+        sha256_single_block(msg, total, out);
+        return;
+    }
     Sha256 s;
     uint8_t len[4];
-    uint32_t kl = (uint32_t)k.size(), vl = (uint32_t)v.size();
     for (int i = 0; i < 4; i++) len[i] = uint8_t(kl >> (8 * i));
     s.update(len, 4);
     s.update((const uint8_t *)k.data(), k.size());
@@ -106,29 +118,122 @@ inline void pair_digest(std::string_view k, std::string_view v,
     s.final(out);
 }
 
-// heterogeneous lookup (C++20): deliver txs probe with string_view, so
-// no temporary std::string is built for keys that already exist — at
-// 5,000 txs/block the allocation traffic was the dominant cost
-struct SvHash {
-    using is_transparent = void;
-    size_t operator()(std::string_view s) const {
-        return std::hash<std::string_view>{}(s);
-    }
-};
-struct SvEq {
-    using is_transparent = void;
-    bool operator()(std::string_view a, std::string_view b) const {
-        return a == b;
-    }
-};
 
+// Flat open-addressing store. The fast-sync workload holds millions of
+// keys (key_space x txs/block), where a node-based unordered_map pays
+// 2-3 cache misses + an allocation per operation. Here: one 64-byte
+// entry per key (value SSO + digest + key ref inline), keys appended to
+// an arena, and a 16-byte inline key prefix that decides nearly every
+// probe without touching the arena. FNV-1a hash; capacity doubles at
+// 0.75 load (tombstone-free: the kv app never deletes).
 struct KVEntry {
+    uint64_t kpre[2];    // first 16 key bytes, zero-padded (+klen juice)
+    uint32_t koff;       // key bytes in the arena
+    uint32_t klen;
     std::string value;
     std::array<uint8_t, 32> digest;  // cached pair digest
 };
 
+inline uint64_t fnv1a(const uint8_t *p, size_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (size_t i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+struct FlatStore {
+    std::vector<int32_t> slots;   // entry index + 1, 0 = empty
+    std::vector<KVEntry> entries;
+    std::string arena;            // append-only key bytes
+    size_t mask = 0;
+
+    FlatStore() { slots.assign(1 << 16, 0); mask = (1 << 16) - 1; }
+
+    static void key_prefix(std::string_view k, uint64_t out[2]) {
+        uint8_t buf[16] = {0};
+        size_t n = k.size() < 16 ? k.size() : 16;
+        std::memcpy(buf, k.data(), n);
+        std::memcpy(&out[0], buf, 8);
+        std::memcpy(&out[1], buf + 8, 8);
+    }
+
+    size_t size() const { return entries.size(); }
+
+    std::string_view key_of(const KVEntry &e) const {
+        return std::string_view(arena.data() + e.koff, e.klen);
+    }
+
+    void grow() {
+        size_t cap = (mask + 1) * 2;
+        std::vector<int32_t> ns(cap, 0);
+        size_t nm = cap - 1;
+        for (size_t i = 0; i < entries.size(); i++) {
+            const KVEntry &e = entries[i];
+            size_t pos = fnv1a((const uint8_t *)arena.data() + e.koff,
+                               e.klen) & nm;
+            while (ns[pos]) pos = (pos + 1) & nm;
+            ns[pos] = int32_t(i) + 1;
+        }
+        slots.swap(ns);
+        mask = nm;
+    }
+
+    // returns the entry for k, or nullptr + the insert slot position
+    KVEntry *find(std::string_view k, uint64_t pre[2], size_t *pos_out) {
+        return find_hashed(k, fnv1a((const uint8_t *)k.data(), k.size()),
+                           pre, pos_out);
+    }
+
+    KVEntry *find_hashed(std::string_view k, uint64_t h, uint64_t pre[2],
+                         size_t *pos_out) {
+        key_prefix(k, pre);
+        size_t pos = h & mask;
+        for (;;) {
+            int32_t s = slots[pos];
+            if (s == 0) {
+                *pos_out = pos;
+                return nullptr;
+            }
+            KVEntry &e = entries[size_t(s) - 1];
+            if (e.kpre[0] == pre[0] && e.kpre[1] == pre[1] &&
+                e.klen == k.size() &&
+                (k.size() <= 16 ||
+                 std::memcmp(arena.data() + e.koff + 16, k.data() + 16,
+                             k.size() - 16) == 0))
+                return &e;
+            pos = (pos + 1) & mask;
+        }
+    }
+
+    KVEntry *insert_at(size_t pos, std::string_view k,
+                       const uint64_t pre[2]) {
+        if ((entries.size() + 1) * 4 > (mask + 1) * 3) {
+            grow();
+            // re-probe in the grown table
+            pos = fnv1a((const uint8_t *)k.data(), k.size()) & mask;
+            while (slots[pos]) pos = (pos + 1) & mask;
+        }
+        KVEntry e;
+        e.kpre[0] = pre[0];
+        e.kpre[1] = pre[1];
+        e.koff = (uint32_t)arena.size();
+        e.klen = (uint32_t)k.size();
+        arena.append(k.data(), k.size());
+        entries.push_back(std::move(e));
+        slots[pos] = int32_t(entries.size());
+        return &entries.back();
+    }
+};
+
 struct KVCore {
-    std::unordered_map<std::string, KVEntry, SvHash, SvEq> store;
+    // guards store/acc/digest state: deliver_batch releases the GIL
+    // for its apply loop, so RPC-thread reads (kv_get / kv_commit /
+    // kv_items) would otherwise race mid-mutation (slot published
+    // before value assigned; grow()/arena realloc under a reader)
+    std::mutex mu;
+    FlatStore store;
     Acc256 acc[KV_BUCKETS];
     uint64_t count[KV_BUCKETS] = {0};
     uint8_t bucket_digest[KV_BUCKETS * 32];
@@ -147,21 +252,31 @@ struct KVCore {
     // set k=v, updating the bucket accumulator (matches the dirty-key
     // replay in kvstore.py commit(), applied eagerly per key)
     void set(std::string_view k, std::string_view v) {
-        int b = crc32_of((const uint8_t *)k.data(), k.size()) &
-                (KV_BUCKETS - 1);
+        set_hashed(k, v, fnv1a((const uint8_t *)k.data(), k.size()));
+    }
+
+    void set_hashed(std::string_view k, std::string_view v, uint64_t h) {
         uint8_t d[32];
         pair_digest(k, v, d);
-        auto it = store.find(k);
-        if (it != store.end()) {
-            acc[b].sub_le(it->second.digest.data());
-            it->second.value.assign(v.data(), v.size());
-            std::memcpy(it->second.digest.data(), d, 32);
+        set_hashed_digest(k, v, h, d);
+    }
+
+    void set_hashed_digest(std::string_view k, std::string_view v,
+                           uint64_t h, const uint8_t d[32]) {
+        int b = crc32_of((const uint8_t *)k.data(), k.size()) &
+                (KV_BUCKETS - 1);
+        uint64_t pre[2];
+        size_t pos;
+        KVEntry *e = store.find_hashed(k, h, pre, &pos);
+        if (e != nullptr) {
+            acc[b].sub_le(e->digest.data());
+            e->value.assign(v.data(), v.size());
+            std::memcpy(e->digest.data(), d, 32);
         } else {
             count[b]++;
-            KVEntry e;
-            e.value.assign(v.data(), v.size());
-            std::memcpy(e.digest.data(), d, 32);
-            store.emplace(std::string(k), std::move(e));
+            e = store.insert_at(pos, k, pre);
+            e->value.assign(v.data(), v.size());
+            std::memcpy(e->digest.data(), d, 32);
         }
         acc[b].add_le(d);
         bucket_dirty[b] = true;
@@ -230,15 +345,13 @@ static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
             return PyLong_FromSsize_t(i);
         }
     }
-    // pass 2: parse + allocate EVERY Python object before the first
-    // core->set — an allocation failure after partial application
-    // would leave the native store diverged from what the caller
-    // believes was applied (a consensus-visible state fork on replay)
-    PyObject *keys = PyList_New(n);
-    if (keys == nullptr) {
-        Py_DECREF(seq);
-        return nullptr;
-    }
+    // pass 2: parse + build the packed key blob, allocating EVERY
+    // Python object before the first core->set — an allocation failure
+    // after partial application would leave the native store diverged
+    // from what the caller believes was applied (a consensus-visible
+    // state fork on replay). Per-key PyBytes are NOT built here: the
+    // wrapper's UniformDeliverResults unpacks keys lazily from the
+    // blob in the rare per-tx-access paths (events, tx index).
     std::vector<std::pair<std::string_view, std::string_view>> kvs(
         (size_t)n);
     std::string packed;  // length-prefixed key blob for compact persist
@@ -248,24 +361,14 @@ static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
         const char *p = PyBytes_AS_STRING(t);
         Py_ssize_t len = PyBytes_GET_SIZE(t);
         const char *eq = (const char *)std::memchr(p, '=', len);
-        PyObject *kobj;
         std::string_view k, v;
         if (eq != nullptr) {
             k = std::string_view(p, eq - p);
             v = std::string_view(eq + 1, len - (eq - p) - 1);
-            kobj = PyBytes_FromStringAndSize(p, eq - p);
         } else {
             k = v = std::string_view(p, len);
-            kobj = t;
-            Py_INCREF(t);
-        }
-        if (kobj == nullptr) {
-            Py_DECREF(seq);
-            Py_DECREF(keys);
-            return nullptr;
         }
         kvs[i] = {k, v};
-        PyList_SET_ITEM(keys, i, kobj);
         uint32_t kl = (uint32_t)k.size();
         char lenb[4];
         for (int j = 0; j < 4; j++) lenb[j] = char(kl >> (8 * j));
@@ -274,17 +377,44 @@ static PyObject *kv_deliver_batch(PyObject *, PyObject *args) {
     }
     PyObject *packed_b = PyBytes_FromStringAndSize(
         packed.data(), (Py_ssize_t)packed.size());
-    PyObject *out = packed_b ? PyTuple_Pack(2, keys, packed_b) : nullptr;
+    PyObject *n_obj = PyLong_FromSsize_t(n);
+    PyObject *out = (packed_b && n_obj)
+        ? PyTuple_Pack(2, n_obj, packed_b) : nullptr;
+    Py_XDECREF(n_obj);
     Py_XDECREF(packed_b);
     if (out == nullptr) {
         Py_DECREF(seq);
-        Py_DECREF(keys);
         return nullptr;
     }
-    // pass 3: apply (no Python allocation from here on)
-    for (auto &kv : kvs) core->set(kv.first, kv.second);
+    // pass 3: apply (no Python allocation from here on; GIL released —
+    // the tx views point into the caller-held bytes objects). The
+    // store spans hundreds of MB at fast-sync scale, so every probe is
+    // a cache miss; hashes are precomputed and the slot word + first
+    // candidate entry are prefetched a few txs ahead, which hides most
+    // of the miss latency behind the SHA-256 pair digests. Prefetches
+    // after a table grow may touch stale positions — harmless, find()
+    // re-probes authoritatively.
+    Py_BEGIN_ALLOW_THREADS
+    {
+        std::lock_guard<std::mutex> lock(core->mu);
+        std::vector<uint64_t> hashes((size_t)n);
+        for (Py_ssize_t i = 0; i < n; i++)
+            hashes[i] = fnv1a((const uint8_t *)kvs[i].first.data(),
+                              kvs[i].first.size());
+        FlatStore &st = core->store;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (i + 8 < n)
+                __builtin_prefetch(&st.slots[hashes[i + 8] & st.mask]);
+            if (i + 4 < n) {
+                int32_t s = st.slots[hashes[i + 4] & st.mask];
+                if (s > 0 && size_t(s) <= st.entries.size())
+                    __builtin_prefetch(&st.entries[size_t(s) - 1]);
+            }
+            core->set_hashed(kvs[i].first, kvs[i].second, hashes[i]);
+        }
+    }
+    Py_END_ALLOW_THREADS
     Py_DECREF(seq);
-    Py_DECREF(keys);
     return out;
 }
 
@@ -298,8 +428,11 @@ static PyObject *kv_set(PyObject *, PyObject *args) {
         return nullptr;
     KVCore *core = kv_from(cap);
     if (core == nullptr) return nullptr;
-    core->set(std::string_view(k, (size_t)kl),
-              std::string_view(v, (size_t)vl));
+    {
+        std::lock_guard<std::mutex> lock(core->mu);
+        core->set(std::string_view(k, (size_t)kl),
+                  std::string_view(v, (size_t)vl));
+    }
     Py_RETURN_NONE;
 }
 
@@ -307,15 +440,18 @@ static PyObject *kv_set(PyObject *, PyObject *args) {
 static PyObject *kv_commit(PyObject *, PyObject *arg) {
     KVCore *core = kv_from(arg);
     if (core == nullptr) return nullptr;
-    if (core->store.empty())
-        return PyBytes_FromStringAndSize(
-            "\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0"
-            "\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0", 32);
-    core->refresh_digests();
     uint8_t out[32];
-    std::vector<uint8_t> level(core->bucket_digest,
-                               core->bucket_digest + KV_BUCKETS * 32);
-    root_from_digests(level, KV_BUCKETS, out);
+    {
+        std::lock_guard<std::mutex> lock(core->mu);
+        if (core->store.size() == 0)
+            return PyBytes_FromStringAndSize(
+                "\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0"
+                "\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0\0", 32);
+        core->refresh_digests();
+        std::vector<uint8_t> level(core->bucket_digest,
+                                   core->bucket_digest + KV_BUCKETS * 32);
+        root_from_digests(level, KV_BUCKETS, out);
+    }
     return PyBytes_FromStringAndSize((const char *)out, 32);
 }
 
@@ -326,28 +462,35 @@ static PyObject *kv_get(PyObject *, PyObject *args) {
     if (!PyArg_ParseTuple(args, "Oy#", &cap, &k, &kl)) return nullptr;
     KVCore *core = kv_from(cap);
     if (core == nullptr) return nullptr;
-    auto it = core->store.find(std::string_view(k, (size_t)kl));
-    if (it == core->store.end()) Py_RETURN_NONE;
-    return PyBytes_FromStringAndSize(it->second.value.data(),
-                                     (Py_ssize_t)it->second.value.size());
+    std::lock_guard<std::mutex> lock(core->mu);
+    uint64_t pre[2];
+    size_t pos;
+    KVEntry *e = core->store.find(std::string_view(k, (size_t)kl), pre,
+                                  &pos);
+    if (e == nullptr) Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize(e->value.data(),
+                                     (Py_ssize_t)e->value.size());
 }
 
 static PyObject *kv_size(PyObject *, PyObject *arg) {
     KVCore *core = kv_from(arg);
     if (core == nullptr) return nullptr;
+    std::lock_guard<std::mutex> lock(core->mu);
     return PyLong_FromSize_t(core->store.size());
 }
 
 static PyObject *kv_items(PyObject *, PyObject *arg) {
     KVCore *core = kv_from(arg);
     if (core == nullptr) return nullptr;
+    std::lock_guard<std::mutex> lock(core->mu);
     PyObject *out = PyList_New((Py_ssize_t)core->store.size());
     if (out == nullptr) return nullptr;
     Py_ssize_t i = 0;
-    for (const auto &kv : core->store) {
+    for (const KVEntry &e : core->store.entries) {
+        std::string_view k = core->store.key_of(e);
         PyObject *pair = Py_BuildValue(
-            "(y#y#)", kv.first.data(), (Py_ssize_t)kv.first.size(),
-            kv.second.value.data(), (Py_ssize_t)kv.second.value.size());
+            "(y#y#)", k.data(), (Py_ssize_t)k.size(),
+            e.value.data(), (Py_ssize_t)e.value.size());
         if (pair == nullptr) {
             Py_DECREF(out);
             return nullptr;
@@ -360,7 +503,7 @@ static PyObject *kv_items(PyObject *, PyObject *arg) {
 static PyMethodDef kv_methods[] = {
     {"kv_new", kv_new, METH_NOARGS, "new KV core handle"},
     {"deliver_batch", kv_deliver_batch, METH_VARARGS,
-     "(core, txs) -> (keys, packed), or int index of first non-kv tx"},
+     "(core, txs) -> (n, packed), or int index of first non-kv tx"},
     {"set_one", kv_set, METH_VARARGS, "(core, key, value)"},
     {"commit", kv_commit, METH_O, "(core) -> 32-byte app hash"},
     {"get", kv_get, METH_VARARGS, "(core, key) -> value | None"},

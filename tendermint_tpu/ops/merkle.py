@@ -149,6 +149,34 @@ def proof_host(items: list[bytes], index: int):
     return _final_hash(n, level[0]), aunts
 
 
+def tree_proofs_host(items: list[bytes]):
+    """(root, [aunts per item]) — every item's proof from one tree
+    build. Native-backed; the fallback builds the level lists once and
+    extracts all proofs from them (never one tree per item)."""
+    n = len(items)
+    from tendermint_tpu import native
+    native_out = native.merkle_tree_proofs(items)
+    if native_out is not None:
+        return native_out
+    level = [leaf_hash(it) for it in items] + \
+        [EMPTY_DIGEST] * (_padded_size(max(n, 1)) - n)
+    levels = []
+    while len(level) > 1:
+        levels.append(level)
+        level = [node_hash(level[i], level[i + 1])
+                 for i in range(0, len(level), 2)]
+    root = _final_hash(n, level[0] if level else EMPTY_DIGEST)
+    proofs = []
+    for index in range(n):
+        idx = index
+        aunts = []
+        for lvl in levels:
+            aunts.append(lvl[idx ^ 1])
+            idx //= 2
+        proofs.append(aunts)
+    return root, proofs
+
+
 def verify_proof_host(root: bytes, total: int, index: int, item: bytes,
                       aunts: list[bytes]) -> bool:
     if not (0 <= index < total) or _padded_size(max(total, 1)) != 1 << len(aunts):

@@ -159,7 +159,21 @@ class Data:
     txs: List[bytes] = field(default_factory=list)
 
     def hash(self) -> bytes:
-        return merkle.root_host(list(self.txs))
+        # cached behind a tuple fingerprint of the tx objects: the
+        # tuple HOLDS references, so object ids stay valid for the
+        # cache's lifetime and the comparison short-circuits on
+        # identity — a 5,000-tx root is ~15k SHA compressions, the
+        # fingerprint check ~100us. Replacing a tx yields a different
+        # object => different fingerprint => recompute (the reference
+        # memoizes Data.Hash the same way, types/block.go:472-478,
+        # with no fingerprint at all).
+        fp = tuple(self.txs)
+        cached = self.__dict__.get("_hash_fp")
+        if cached is not None and cached[0] == fp:
+            return cached[1]
+        h = merkle.root_host(list(fp))
+        self.__dict__["_hash_fp"] = (fp, h)
+        return h
 
     def to_obj(self):
         return {"txs": [t.hex() for t in self.txs]}

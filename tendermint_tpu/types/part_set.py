@@ -42,11 +42,10 @@ class PartSet:
     @classmethod
     def from_data(cls, data: bytes, part_size: int) -> "PartSet":
         chunks = [data[i:i + part_size] for i in range(0, len(data), part_size)] or [b""]
-        root = merkle.root_host(chunks)
+        root, proofs = merkle.tree_proofs_host(chunks)
         ps = cls(len(chunks), root)
         for i, c in enumerate(chunks):
-            _, aunts = merkle.proof_host(chunks, i)
-            ps.parts[i] = Part(i, c, aunts)
+            ps.parts[i] = Part(i, c, proofs[i])
         ps.count = len(chunks)
         ps._size = len(data)
         return ps
