@@ -218,6 +218,7 @@ class ValidatorSet:
         # test_commit_items_sign_bytes_match
         from tendermint_tpu.types.vote import sign_bytes_template
         tmpl: dict = {}
+        sb_memo: dict = {}
         for idx, pc in enumerate(commit.precommits):
             if pc is None:
                 continue
@@ -228,12 +229,20 @@ class ValidatorSet:
             val = self.validators[idx]
             bid = pc.block_id
             tkey = (bid.hash, bid.parts.total, bid.parts.hash)
-            t = tmpl.get(tkey)
-            if t is None:
-                t = sign_bytes_template(chain_id, bid, height, round_,
-                                        pc.type)
-                tmpl[tkey] = t
-            sb = (t[0] + str(pc.timestamp_ns) + t[1]).encode()
+            # sign bytes are fully determined by (block_id, timestamp)
+            # within one commit — and votes in a commit often SHARE a
+            # timestamp (synthetic chains always, real chains per
+            # proposer round), so the encode is memoized on both
+            skey = (tkey, pc.timestamp_ns)
+            sb = sb_memo.get(skey)
+            if sb is None:
+                t = tmpl.get(tkey)
+                if t is None:
+                    t = sign_bytes_template(chain_id, bid, height,
+                                            round_, pc.type)
+                    tmpl[tkey] = t
+                sb = (t[0] + str(pc.timestamp_ns) + t[1]).encode()
+                sb_memo[skey] = sb
             items.append((val.pubkey, sb, pc.signature))
             item_power.append((val.voting_power, bid == block_id))
         return items, item_power
