@@ -129,12 +129,18 @@ def run(n_headers: int = 2000, n_vals: int = 64,
 
 
 def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
-                 wave: int = 16384) -> dict:
+                 wave: int = 16384, deadline: float = None) -> dict:
     """Config 5 at FULL scale: 1M headers x 64 validators, streamed —
     build a wave (untimed: TPU batch signing via ops/ed25519.sign_batch,
     ~5-6us/signature end-to-end), certify it (timed), alternate. Memory
     stays bounded at one wave; sustained headers/s across all timed
-    waves is the headline, per VERDICT r3 item 4."""
+    waves is the headline, per VERDICT r3 item 4.
+
+    `deadline` (time.monotonic() timestamp): stop cleanly after the
+    current wave once passed — the artifact then reports the achieved
+    header count with scaled_to_budget=True instead of the driver
+    SIGTERM-ing mid-arm and losing the whole result (VERDICT r4
+    weak #1)."""
     from tendermint_tpu.lite.certifier import certify_chain
     from tendermint_tpu.lite.types import FullCommit, SignedHeader
     from tendermint_tpu.models.verifier import default_verifier
@@ -146,6 +152,23 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
     from tendermint_tpu.types.vote import Vote, VoteType
 
     chain_id = "bench-lite"
+    # Signature disk cache: the wave build is UNTIMED setup (the metric
+    # is certify headers/s), but 64M device signatures cost ~6 min of
+    # wall clock the driver budget can't spare — so waves persist their
+    # signatures once per box, keyed by every parameter that shapes
+    # them. certify_chain re-verifies every cached signature, so a
+    # corrupt cache fails the arm loudly rather than passing silently.
+    # TM_BENCH_NO_SIGCACHE=1 disables (fields report cache use either
+    # way).
+    cache_dir = None
+    if not os.environ.get("TM_BENCH_NO_SIGCACHE"):
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), ".bench_sigcache")
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+        except OSError:
+            cache_dir = None
+    cache_hits = 0
     seeds = [(i + 1).to_bytes(32, "little") for i in range(n_vals)]
     keys = [PrivKey.generate(s) for s in seeds]
     valset = ValidatorSet([Validator(k.pubkey.ed25519, 10) for k in keys])
@@ -170,10 +193,13 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
     wave_rates = []
     done = 0
     while done < n_headers:
+        if deadline is not None and done > 0 and \
+                time.monotonic() >= deadline:
+            break
         tb = time.perf_counter()
         n_w = min(wave, n_headers - done)
         heights = range(done + 1, done + n_w + 1)
-        headers, bids, msgs = [], [], []
+        headers, bids = [], []
         for h in heights:
             header = Header(chain_id=chain_id, height=h, time_ns=h,
                             validators_hash=vhash,
@@ -181,16 +207,37 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
             bid = BlockID(header.hash(), PartSetHeader(1, b"\x22" * 32))
             headers.append(header)
             bids.append(bid)
-            # every validator signs the SAME canonical bytes (v0.16
-            # sign bytes carry no validator identity; one timestamp)
-            msgs.append(Vote(vals[0].address, 0, h, 0, h,
-                             VoteType.PRECOMMIT, bid).sign_bytes(chain_id))
-        sig_seeds = [seeds[idx_of[j]]
-                     for _ in range(n_w) for j in range(n_vals)]
-        sig_msgs = [m for m in msgs for _ in range(n_vals)]
-        # dispatch signing, then build the vote/commit objects WHILE
-        # the device computes R = r*B — signatures attach at resolve
-        resolver = ed.sign_batch_async(sig_seeds, sig_msgs)
+        wave_idx = done // wave
+        cpath = None
+        blob = None
+        if cache_dir is not None:
+            cpath = os.path.join(
+                cache_dir, f"{chain_id}-v{n_vals}-w{wave}"
+                           f"-i{wave_idx}-n{n_w}.sig")
+            try:
+                if os.path.getsize(cpath) == n_w * n_vals * 64:
+                    with open(cpath, "rb") as f:
+                        blob = f.read()
+                    cache_hits += 1
+            except OSError:
+                pass
+        resolver = None
+        if blob is None:
+            # sign-bytes only exist on the signing path — every
+            # validator signs the SAME canonical bytes per header
+            # (v0.16 sign bytes carry no validator identity; one
+            # timestamp); a cache hit skips the n_w encodes entirely
+            msgs = [Vote(vals[0].address, 0, h, 0, h,
+                         VoteType.PRECOMMIT,
+                         bids[h - (done + 1)]).sign_bytes(chain_id)
+                    for h in heights]
+            sig_seeds = [seeds[idx_of[j]]
+                         for _ in range(n_w) for j in range(n_vals)]
+            sig_msgs = [m for m in msgs for _ in range(n_vals)]
+            # dispatch signing, then build the vote/commit objects
+            # WHILE the device computes R = r*B — signatures attach at
+            # resolve
+            resolver = ed.sign_batch_async(sig_seeds, sig_msgs)
         fcs = []
         all_votes = []
         for i, h in enumerate(heights):
@@ -203,8 +250,21 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
             fcs.append(FullCommit(
                 SignedHeader(headers[i], Commit(bids[i], precommits),
                              bids[i]), valset))
-        for v, sig in zip(all_votes, resolver()):
-            v.signature = sig
+        if blob is not None:
+            for i, v in enumerate(all_votes):
+                v.signature = blob[64 * i:64 * (i + 1)]
+        else:
+            sigs = resolver()
+            for v, sig in zip(all_votes, sigs):
+                v.signature = sig
+            if cpath is not None:
+                try:  # atomic publish; a failed write just skips cache
+                    tmp = cpath + f".{os.getpid()}.tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(b"".join(sigs))
+                    os.replace(tmp, cpath)
+                except OSError:
+                    pass
         build_s += time.perf_counter() - tb
 
         if done == 0:
@@ -232,9 +292,12 @@ def run_streamed(n_headers: int = 1_000_000, n_vals: int = 64,
         # the median wave separates capability from transient load
         "median_wave_headers_per_sec": round(
             wave_rates[len(wave_rates) // 2], 1),
-        "headers": done, "vals_per_header": n_vals,
+        "headers": done, "target_headers": n_headers,
+        "scaled_to_budget": done < n_headers,
+        "vals_per_header": n_vals,
         "waves": (done + wave - 1) // wave, "wave_headers": wave,
         "sig_verifies_per_sec": round(done * n_vals / timed_s, 1),
+        "sig_cache_waves": cache_hits,
         "certify_s": round(timed_s, 3), "build_s": round(build_s, 1),
         "warm_s": round(warm_s, 1),
         "total_wall_s": round(time.perf_counter() - t_all, 1),
