@@ -271,7 +271,15 @@ def cmd_replay(args, console: bool = False) -> int:
     config = default_config(args.home)
     gen_doc = GenesisDoc.load(
         os.path.join(args.home, "config", "genesis.json"))
-    node = Node(config, gen_doc, priv_validator=None)
+    # readonly WAL: a writable open would trim a live writer's
+    # in-flight frame and corrupt the log. NOTE this protects the WAL
+    # only — the node handshake still opens the state/block stores
+    # writable (as the reference's replay_file does), so the tool is
+    # for stopped nodes / copied data dirs, not a running node's home.
+    print("replay: do not run against a RUNNING node's data dir "
+          "(stores open writable; the WAL itself is opened read-only)",
+          file=sys.stderr)
+    node = Node(config, gen_doc, priv_validator=None, wal_readonly=True)
     cs, wal = node.consensus, node.wal
     height = cs.state.last_block_height
     # same tail selection as node-start catchup (incl. the legacy

@@ -280,6 +280,7 @@ class ConsensusReactor(Reactor):
                     return  # malformed: ignore rather than KeyError-drop
                 bid = BlockID.from_obj(msg["block_id"])
                 bits = None
+                bad_claim = None
                 with self.cs._lock:
                     rs = self.cs.rs
                     if rs.height == msg["height"] and rs.votes is not None:
@@ -287,17 +288,27 @@ class ConsensusReactor(Reactor):
                             rs.votes.set_peer_maj23(
                                 msg["round"], msg["vote_type"], peer.id, bid)
                         except ValueError as e:
-                            # conflicting claim from the same peer: the
-                            # reference discards the error without
-                            # dropping the peer (consensus/reactor.go
-                            # ignores SetPeerMaj23's return)
-                            self.cs.logger.info(
-                                "bad maj23 claim", peer=peer.id, err=str(e))
-                        vs = (rs.votes.prevotes(msg["round"])
-                              if msg["vote_type"] == VoteType.PREVOTE
-                              else rs.votes.precommits(msg["round"]))
-                        bits = [i for i, v in enumerate(vs.votes)
-                                if v is not None] if vs else []
+                            # conflicting maj23 claim from the same
+                            # peer: the reference stops the peer and
+                            # sends NO VoteSetBits reply
+                            # (consensus/reactor.go:208-212)
+                            bad_claim = e
+                        else:
+                            vs = (rs.votes.prevotes(msg["round"])
+                                  if msg["vote_type"] == VoteType.PREVOTE
+                                  else rs.votes.precommits(msg["round"]))
+                            # reply shows which votes we have FOR the
+                            # claimed block id (BitArrayByBlockID,
+                            # consensus/reactor.go:216-222)
+                            bits = [i for i, b in enumerate(
+                                vs.bit_array_by_block_id(bid))
+                                if b] if vs else []
+                if bad_claim is not None:
+                    self.cs.logger.info("bad maj23 claim", peer=peer.id,
+                                        err=str(bad_claim))
+                    if self.switch is not None:
+                        self.switch.stop_peer_for_error(peer, bad_claim)
+                    return
                 if bits is not None:  # only answer for our current height
                     peer.try_send_obj(VOTE_SET_BITS_CHANNEL, {
                         "type": "vote_set_bits", "height": msg["height"],

@@ -47,6 +47,19 @@ def wal_tail_for(wal, height: int) -> Optional[list]:
     inconsistency must surface, not be replayed into genesis state."""
     tail = wal.messages_after_end_height(height)
     if tail is not None:
+        # marker found; but the tail itself must not span FURTHER
+        # committed heights — that means the state store is behind the
+        # WAL (wiped/rolled back), and replaying those heights silently
+        # would double-execute them. The reference's catchupReplay
+        # errors the same way ("WAL should not contain #ENDHEIGHT",
+        # consensus/replay.go).
+        for m in tail:
+            if m.msg.get("type") == "endheight" and \
+                    m.msg.get("height", 0) > height:
+                raise ValueError(
+                    f"WAL contains #ENDHEIGHT {m.msg['height']} past "
+                    f"state height {height} (state store behind WAL?) "
+                    "— refusing replay")
         return tail  # may be [] — marker found, clean shutdown
     if height != 0:
         raise ValueError(f"WAL has no #ENDHEIGHT for {height}")

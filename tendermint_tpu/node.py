@@ -34,7 +34,8 @@ class Node:
     def __init__(self, config: Config, gen_doc: GenesisDoc,
                  priv_validator=None, app=None, client_creator=None,
                  mempool=None, evidence_pool=None, in_memory=False,
-                 with_p2p=False, fast_sync=False, with_rpc=False):
+                 with_p2p=False, fast_sync=False, with_rpc=False,
+                 wal_readonly=False):
         from tendermint_tpu.utils.log import get_logger
         # logging is configured once at the CLI entry point; constructing
         # a Node (tests build several in-process) must not reconfigure
@@ -111,7 +112,8 @@ class Node:
             self.wal = NilWAL()
         else:
             self.wal = WAL(config.path(config.consensus.wal_path),
-                           light=config.consensus.wal_light)
+                           light=config.consensus.wal_light,
+                           readonly=wal_readonly)
 
         self.consensus = ConsensusState(
             config.consensus, state, self.block_exec, self.block_store,

@@ -206,22 +206,48 @@ def _buffer_resyncs(buf, start: int, end: int) -> bool:
 
 
 def _frame_chain_resyncs(f, start: int, size: int) -> bool:
-    """File wrapper over _buffer_resyncs. The region is < _MAX_FRAME +
-    header (pass 1 bounds it), so it is scanned in memory — a
-    per-offset seek/read loop would cost millions of file-object calls
-    on a near-_MAX_FRAME torn frame."""
-    f.seek(start)
-    buf = f.read(size - start)
-    return _buffer_resyncs(buf, 0, len(buf))
+    """File wrapper over _buffer_resyncs. Pass 1 usually bounds the
+    region to < _MAX_FRAME + header (scanned in one read), but the
+    zero-header torn case (filesystem zero-fill after power loss) can
+    leave up to rotate_bytes of tail — that path scans in overlapping
+    windows so startup memory stays bounded. Windows overlap by
+    _MAX_FRAME + header bytes, so any complete frame that starts inside
+    the region is fully contained in some window."""
+    chunk = 8 << 20
+    overlap = _MAX_FRAME + _HEADER.size
+    if size - start <= chunk + overlap:
+        f.seek(start)
+        buf = f.read(size - start)
+        return _buffer_resyncs(buf, 0, len(buf))
+    pos = start
+    while pos < size:
+        win_end = min(size, pos + chunk + overlap)
+        f.seek(pos)
+        buf = f.read(win_end - pos)
+        if _buffer_resyncs(buf, 0, len(buf)):
+            return True
+        pos += chunk
+    return False
 
 
 class WAL:
     def __init__(self, path: str, rotate_bytes: int = 64 << 20,
-                 max_backups: int = 16, light: bool = False):
+                 max_backups: int = 16, light: bool = False,
+                 readonly: bool = False):
         self.path = path
         self.rotate_bytes = rotate_bytes
         self.max_backups = max_backups
         self.light = light  # light mode skips peer messages (wal.go:121-128)
+        self.readonly = readonly
+        if readonly:
+            # Inspection mode (the replay CLI may point at a LIVE
+            # node's data dir): NO torn-tail trim — opening used to
+            # truncate the live writer's partially-flushed frame, which
+            # the writer then appends past, corrupting the log — no
+            # `#ENDHEIGHT 0` planting, and save()/flush() are no-ops.
+            # The readers already tolerate a torn head-file tail.
+            self._f = None
+            return
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         _trim_torn_tail(path)
         self._f = open(path, "ab")
@@ -243,6 +269,8 @@ class WAL:
     # -- writing -------------------------------------------------------------
 
     def save(self, msg: dict, time_ns: int = 0) -> None:
+        if self._f is None:  # readonly inspection handle
+            return
         if self.light and msg.get("peer"):
             return
         self._f.write(encode_frame(WALMessage(time_ns, msg)))
@@ -259,10 +287,14 @@ class WAL:
         self.save(EndHeightMessage(height))
 
     def flush(self) -> None:
+        if self._f is None:
+            return
         self._f.flush()
         os.fsync(self._f.fileno())
 
     def close(self) -> None:
+        if self._f is None:
+            return
         self._f.flush()
         self._f.close()
 
