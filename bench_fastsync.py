@@ -248,6 +248,11 @@ def run_large(n_blocks: int = 20480, n_vals: int = 64,
                backend="auto")
     sync_chain(warm_builder.gen, warm_blocks, verify_window=verify_window,
                backend="auto")
+    # wave tails produce arbitrary window sizes -> every pow2 bucket
+    # (full + pre kernels) must be compiled BEFORE the timed waves; a
+    # first-ever tail bucket otherwise pays its Mosaic compile inside
+    # the timed region (r5: sustained 30 vs 240+ blocks/s, all compile)
+    BatchVerifier("jax").warmup_buckets()
 
     builder = ChainBuilder(n_vals, n_txs)
     t0 = time.perf_counter()

@@ -287,6 +287,31 @@ class BatchVerifier:
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         return bool(self.verify([(pubkey, msg, sig)])[0])
 
+    def warmup_buckets(self, max_chunk: int = BATCH_CHUNK) -> None:
+        """Compile EVERY power-of-two bucket shape up to max_chunk, for
+        both the full kernel and the predecompressed variant (repeated
+        same-content batches engage the predecomp cache on the second
+        sighting). Streaming workloads (fast-sync waves) produce
+        arbitrary tail-window sizes; each lands in one of these buckets
+        (ed25519._bucket), so this closes the shape set — without it, a
+        first-ever tail size pays a multi-ten-second Mosaic compile
+        inside the timed region."""
+        if self.backend == "python":
+            return
+        from tendermint_tpu.ops import ed25519
+        if not self._mesh_resolved:
+            self._resolve_mesh()  # warm the kernel verify() will use
+        b = 512
+        while b <= max_chunk:
+            items = [(b"\x00" * 32, b"", b"\x00" * 64)] * b
+            for _ in range(2):  # 2nd pass: predecomp cache -> pre kernel
+                ed25519.verify_batch([it[0] for it in items],
+                                     [it[1] for it in items],
+                                     [it[2] for it in items],
+                                     kernel=self.kernel,
+                                     min_bucket=self._min_bucket)
+            b *= 2
+
     def warmup(self, n_sigs: int) -> None:
         """Compile every kernel shape a verify() of n_sigs total items
         will dispatch (the full BATCH_CHUNK shape and the padded tail

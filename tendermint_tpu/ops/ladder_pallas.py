@@ -298,7 +298,8 @@ def _to_bytes_t(x):
 # ---------------------------------------------------------------------------
 
 def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
-                   d_ref, d2_ref, sqrt_m1_ref, out_ref, an_scratch):
+                   d_ref, d2_ref, sqrt_m1_ref, out_ref, an_scratch,
+                   n_windows: int = 64):
     """out[B] = 1 iff the signature verifies.
 
     pk, rb:      int32[32, B] pubkey / signature-R bytes.
@@ -352,11 +353,11 @@ def _verify_kernel(pk_ref, rb_ref, dig_s_ref, dig_h_ref, s_table_ref,
     a_neg = tuple(an_scratch[c] for c in range(4))
 
     _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
-                 s_table_ref, d2, out_ref)
+                 s_table_ref, d2, out_ref, n_windows=n_windows)
 
 
 def _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
-                 s_table_ref, d2, out_ref):
+                 s_table_ref, d2, out_ref, n_windows: int = 64):
     """Everything after decompression — table build, the Straus-w4
     ladder, affine conversion, encode, R compare — shared by the full
     and predecompressed kernels (inlined at trace time; one definition
@@ -386,7 +387,12 @@ def _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
             for c in range(3)))          # (X, Y, T*d2); Z == 1 implied
 
     def body(i, acc):
-        w = 63 - i
+        # msb-first Horner over the LOW n_windows 4-bit windows —
+        # n_windows=64 covers full scalars (production); smaller counts
+        # serve interpret-mode differential tests with crafted small
+        # scalars (same code path, proportionally less interpreter
+        # runtime), valid because digits >= n_windows are zero there
+        w = n_windows - 1 - i
         ds_w = jnp.where(ok, dig_s_ref[pl.ds(w, 1), :][0], 0)
         dh_w = jnp.where(ok, dig_h_ref[pl.ds(w, 1), :][0], 0)
         acc = acc + (None,)
@@ -398,7 +404,8 @@ def _ladder_tail(bsz, ok, a_neg, rb_ref, dig_s_ref, dig_h_ref,
         acc = _pt_add_tbl(acc, _pt_select(dh_w, h_table), want_t=False)
         return acc[:3]
 
-    X, Y, Z = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz)[:3])
+    X, Y, Z = jax.lax.fori_loop(0, n_windows, body,
+                                _pt_identity(bsz)[:3])
 
     # ---- encode result + compare with R (curve.encode, transposed)
     zi = _inv_t(Z)
@@ -423,7 +430,7 @@ def _consts_np():
 
 
 def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
-                  interpret: bool = False):
+                  interpret: bool = False, n_windows: int = 64):
     """Fully-fused device verification: bool[N] verdicts.
 
     Same contract as ed25519.verify_kernel; the whole pipeline
@@ -439,8 +446,17 @@ def verify_pallas(pk_u8, rb_u8, s_bits, h_bits, tile: int = DEFAULT_TILE,
     dig_s = _digits4_t(s_bits)
     dig_h = _digits4_t(h_bits)
 
+    if n_windows == 64:
+        kernel_fn = _verify_kernel  # the production path keeps the
+        # bare function: a functools.partial here embeds its repr
+        # (with a process-local address) in the lowered module name,
+        # which silently misses the persistent compile cache every run
+    else:
+        def kernel_fn(*refs):
+            return _verify_kernel(*refs, n_windows=n_windows)
+        kernel_fn.__name__ = f"_verify_kernel_w{n_windows}"
     out = pl.pallas_call(
-        _verify_kernel,
+        kernel_fn,
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=0,
@@ -533,7 +549,7 @@ def verify_pallas_pre(xn_bytes, y_bytes, ok, rb_u8, s_bits, h_bits,
     return out[0].astype(jnp.bool_)
 
 
-def _sign_kernel(dig_r_ref, s_table_ref, out_ref):
+def _sign_kernel(dig_r_ref, s_table_ref, out_ref, n_windows: int = 64):
     """enc(r*B) for a batch of scalars — the device half of batched
     Ed25519 SIGNING (R = r*B; the host derives r, k, and s). A strict
     subset of the verify ladder: fixed-base windows only (no h-table,
@@ -547,7 +563,7 @@ def _sign_kernel(dig_r_ref, s_table_ref, out_ref):
             for c in range(3)))
 
     def body(i, acc):
-        w = 63 - i
+        w = n_windows - 1 - i  # low windows; 64 = full scalars
         dr_w = dig_r_ref[pl.ds(w, 1), :][0]
         acc = acc + (None,)
         for _ in range(3):
@@ -559,7 +575,8 @@ def _sign_kernel(dig_r_ref, s_table_ref, out_ref):
         acc = _pt_add_tbl(acc, (sx, sy, None, std2), want_t=False)
         return acc[:3]
 
-    X, Y, Z = jax.lax.fori_loop(0, 64, body, _pt_identity(bsz)[:3])
+    X, Y, Z = jax.lax.fori_loop(0, n_windows, body,
+                                _pt_identity(bsz)[:3])
     zi = _inv_t(Z)
     xa = _mul_t(X, zi)
     ya = _mul_t(Y, zi)
@@ -570,7 +587,7 @@ def _sign_kernel(dig_r_ref, s_table_ref, out_ref):
 
 
 def sign_pallas_rB(r_bytes_u8, tile: int = DEFAULT_TILE,
-                   interpret: bool = False):
+                   interpret: bool = False, n_windows: int = 64):
     """uint8[N,32] little-endian scalars (each < L) -> uint8[N,32]
     canonical encodings of r*B."""
     n = r_bytes_u8.shape[0]
@@ -581,8 +598,15 @@ def sign_pallas_rB(r_bytes_u8, tile: int = DEFAULT_TILE,
     dig = bits.reshape(256, n).reshape(64, 4, n)
     dig_r = dig[:, 0] + 2 * dig[:, 1] + 4 * dig[:, 2] + 8 * dig[:, 3]
 
+    if n_windows == 64:
+        kernel_fn = _sign_kernel  # bare: see verify_pallas — partial
+        # would bust the persistent compile cache
+    else:
+        def kernel_fn(*refs):
+            return _sign_kernel(*refs, n_windows=n_windows)
+        kernel_fn.__name__ = f"_sign_kernel_w{n_windows}"
     out = pl.pallas_call(
-        _sign_kernel,
+        kernel_fn,
         out_shape=jax.ShapeDtypeStruct((32, n), jnp.int32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=0,

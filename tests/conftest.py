@@ -27,7 +27,8 @@ _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
 _flags.append("--xla_force_host_platform_device_count=8")
 # the suite is COMPILE-bound on this 1-core host (the interpreted pallas
 # kernel alone costs ~4 min at full opt); O0 keeps semantics, cuts ~30%
-_flags.append("--xla_backend_optimization_level=0")
+if not os.environ.get("TM_TEST_NO_O0"):
+    _flags.append("--xla_backend_optimization_level=0")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 # default_verifier()'s mesh="auto" would see the 8 virtual devices and
