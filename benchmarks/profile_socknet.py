@@ -1,0 +1,133 @@
+"""Per-node CPU profile of the real-socket testnet (VERDICT r5 item 5).
+
+Boots the same 4-process TCP testnet as bench_testnet.run_socket with
+TM_NODE_PROFILE set for every node (the cli's SIGPROF sampler), spams
+txs for a window, stops the nodes with SIGINT (so their samplers dump),
+and prints each node's top frames.
+
+Usage: python benchmarks/profile_socknet.py [duration_s]
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from bench_util import free_port_block, node_child_env  # noqa: E402
+
+
+def main():
+    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    n_vals, n_txs_target = 4, 1000
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = node_child_env(repo)
+    net = tempfile.mkdtemp(prefix="profile-socknet-")
+    base = free_port_block(2 * n_vals)
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+         "--n", str(n_vals), "--output", net, "--base-port", str(base),
+         "--chain-id", "prof-socknet"],
+        env=env, check=True, capture_output=True, timeout=120)
+    for i in range(n_vals):
+        cfg_path = os.path.join(net, f"node{i}", "config", "config.json")
+        cfg = json.load(open(cfg_path))
+        cfg["consensus"].update({
+            "timeout_propose": 400, "timeout_propose_delta": 100,
+            "timeout_prevote": 200, "timeout_prevote_delta": 100,
+            "timeout_precommit": 200, "timeout_precommit_delta": 100,
+            "timeout_commit": 100,
+            "max_block_size_txs": n_txs_target})
+        cfg["mempool"] = dict(cfg.get("mempool", {}), size=4000)
+        json.dump(cfg, open(cfg_path, "w"))
+
+    procs = []
+    prof_paths = []
+    try:
+        for i in range(n_vals):
+            penv = dict(env)
+            prof = os.path.join(net, f"node{i}.prof")
+            prof_paths.append(prof)
+            penv["TM_NODE_PROFILE"] = prof
+            log = open(os.path.join(net, f"node{i}.log"), "w")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tendermint_tpu.cli",
+                 "--home", os.path.join(net, f"node{i}"),
+                 "node", "--p2p", "--no-fast-sync",
+                 "--rpc-laddr", f"tcp://127.0.0.1:{base + 2 * i + 1}",
+                 "--max-seconds", "600"],
+                env=penv, stdout=log, stderr=subprocess.STDOUT))
+
+        from tendermint_tpu.rpc.client import JSONRPCClient, WSClient
+        clients = [JSONRPCClient(f"http://127.0.0.1:{base + 2 * i + 1}")
+                   for i in range(n_vals)]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                if all(c.call("status")["latest_block_height"] >= 2
+                       for c in clients):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("no progress")
+
+        stop = threading.Event()
+
+        def spam(tid):
+            ws = None
+            i = 0
+            while not stop.is_set():
+                try:
+                    if ws is None:
+                        ws = WSClient("127.0.0.1",
+                                      base + 2 * (tid % n_vals) + 1)
+                    for _ in range(64):
+                        ws.cast("broadcast_tx_sync",
+                                tx=(b"s%d.%d=v" % (tid, i)).hex())
+                        i += 1
+                    while not stop.is_set() and ws.call(
+                            "num_unconfirmed_txs",
+                            timeout=30.0)["n_txs"] > 3000:
+                        time.sleep(0.2)
+                except Exception:
+                    ws = None
+                    time.sleep(0.2)
+
+        sp = [threading.Thread(target=spam, args=(t,), daemon=True)
+              for t in range(2)]
+        for t in sp:
+            t.start()
+        h0 = clients[0].call("status")["latest_block_height"]
+        time.sleep(duration)
+        h1 = clients[0].call("status")["latest_block_height"]
+        stop.set()
+        print(f"window: {h1 - h0} blocks in {duration}s = "
+              f"{(h1 - h0) / duration:.2f} blocks/s")
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    for i, prof in enumerate(prof_paths):
+        print(f"\n===== node{i} profile =====")
+        try:
+            print(open(prof).read()[:2400])
+        except OSError as e:
+            print("missing:", e)
+            print(open(os.path.join(net, f"node{i}.log")).read()[-600:])
+
+
+if __name__ == "__main__":
+    main()

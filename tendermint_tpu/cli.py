@@ -45,6 +45,44 @@ def cmd_node(args) -> int:
     from tendermint_tpu.utils.log import setup_logging
     setup_logging(default_config(args.home).base.log_level)
     app = {"kvstore": KVStoreApp, "counter": CounterApp}[args.app]()
+    # TM_NODE_PROFILE=<path>: sampling profiler over EVERY thread
+    # (SIGPROF at ~97 Hz of CPU time, sys._current_frames) — the
+    # profiling story for multi-process testnets, where each node
+    # samples itself and dumps top frames on shutdown. cProfile can't
+    # do this (per-thread, and its tracing overhead skews the 1-core
+    # contention being measured); the unsafe RPC profiler routes cover
+    # interactive single-node use.
+    prof_path = os.environ.get("TM_NODE_PROFILE")
+    if prof_path:
+        import collections
+        import signal as _signal
+        samples = collections.Counter()
+
+        def _sample(signum, frame):
+            # NOTE: samples EVERY thread's current frame per tick, so
+            # parked threads surface as wait/accept/select rows —
+            # read those as thread residency; the remaining rows are
+            # the CPU story
+            for fr in sys._current_frames().values():
+                # leaf frame + its caller: enough to attribute cost
+                co = fr.f_code
+                caller = fr.f_back.f_code if fr.f_back else None
+                samples[(co.co_filename, co.co_name,
+                         caller.co_name if caller else "")] += 1
+
+        _signal.signal(_signal.SIGPROF, _sample)
+        _signal.setitimer(_signal.ITIMER_PROF, 0.0103, 0.0103)
+        import atexit
+
+        def _dump():
+            _signal.setitimer(_signal.ITIMER_PROF, 0)
+            total = sum(samples.values()) or 1
+            with open(prof_path, "w") as f:
+                f.write(f"# {total} samples (CPU time, all threads)\n")
+                for (fn, name, caller), c in samples.most_common(60):
+                    f.write(f"{100*c/total:6.2f}% {name} <- {caller} "
+                            f"({fn})\n")
+        atexit.register(_dump)
     node = default_node(args.home, app=app, with_p2p=args.p2p,
                         fast_sync=(args.fast_sync if args.p2p else False))
     if args.p2p_laddr:

@@ -31,7 +31,12 @@ DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
 
-GOSSIP_SLEEP_S = 0.02
+GOSSIP_SLEEP_S = 0.1
+# ^ idle BACKSTOP for the event-driven gossip loops (configurable via
+# gossip_sleep_s / peer_gossip_sleep_ms): matches the reference's
+# peerGossipSleepDuration (config.go:445, 100 ms). The per-peer wake
+# Event makes the common case latency-free; the backstop catches any
+# missed edge.
 
 
 class PeerRoundState:
@@ -49,6 +54,12 @@ class PeerRoundState:
         self.last_commit_round = -1
         # (height, round, type) -> set of validator indices known to peer
         self.votes_known: Dict[tuple, set] = {}
+        # wake signal for this peer's gossip threads: set whenever our
+        # own state gains something sendable OR the peer's state
+        # changes; the gossip loops park on it instead of polling
+        # (the reference polls at 100 ms — on a shared-core testnet the
+        # per-iteration Python cost made that ~26% of each node's CPU)
+        self.wake = threading.Event()
 
     def apply_new_round_step(self, msg: dict) -> None:
         with self.lock:
@@ -67,6 +78,10 @@ class PeerRoundState:
                 self.votes_known = {
                     k: v for k, v in self.votes_known.items()
                     if k[0] >= self.height - 1}
+        # set AFTER the state write: a waiter that consumed the wake
+        # and re-scanned before the write would otherwise see stale
+        # state and park through the whole idle backstop
+        self.wake.set()
 
     def set_has_vote(self, height: int, round_: int, type_: int,
                      index: int) -> None:
@@ -330,6 +345,10 @@ class ConsensusReactor(Reactor):
                                 "height": msg["height"],
                                 "round": msg.get("round", -1),
                                 "part": msg["part"]}, peer.id)
+            # relay promptly: other peers' data-gossip threads may now
+            # have a new proposal/part to forward (multi-hop nets would
+            # otherwise wait on the idle backstop per hop)
+            self._wake_all_gossip()
 
         elif ch_id == VOTE_CHANNEL:
             if self.fast_sync:
@@ -348,9 +367,16 @@ class ConsensusReactor(Reactor):
 
     # ---------------------------------------------- internal event broadcast
 
+    def _wake_all_gossip(self) -> None:
+        for ps in list(self.peer_states.values()):
+            ps.wake.set()
+
     def _on_internal_broadcast(self, msg: dict) -> None:
         """Hook on ConsensusState._broadcast: announce step changes and
-        vote possession; data/votes flow through the gossip threads."""
+        vote possession; data/votes flow through the gossip threads —
+        woken here, since a local step/vote/proposal change is exactly
+        when they may have something new to send."""
+        self._wake_all_gossip()
         if self.switch is None:
             return
         t = msg.get("type")
@@ -380,7 +406,8 @@ class ConsensusReactor(Reactor):
         """consensus/reactor.go:466 gossipDataRoutine."""
         while self._peer_alive(peer):
             if self.fast_sync:
-                time.sleep(self.gossip_sleep_s)
+                ps.wake.wait(self.gossip_sleep_s)
+                ps.wake.clear()
                 continue
             sent = False
             catchup_height = 0
@@ -438,7 +465,11 @@ class ConsensusReactor(Reactor):
                     ps.set_has_part(part_msg["part"]["index"])
                     sent = True
             if not sent:
-                time.sleep(self.gossip_sleep_s)
+                # park until something changes (local state or peer
+                # state), with the reference's 100 ms idle backstop
+                # (consensus/reactor.go peerGossipSleepDuration)
+                ps.wake.wait(self.gossip_sleep_s)
+                ps.wake.clear()
 
     # -------------------------------------------------------- gossip: votes
 
@@ -446,7 +477,8 @@ class ConsensusReactor(Reactor):
         """consensus/reactor.go:604 gossipVotesRoutine."""
         while self._peer_alive(peer):
             if self.fast_sync:
-                time.sleep(self.gossip_sleep_s)
+                ps.wake.wait(self.gossip_sleep_s)
+                ps.wake.clear()
                 continue
             vote_msg = None
             catchup_height = 0
@@ -494,7 +526,8 @@ class ConsensusReactor(Reactor):
                     ps.set_has_vote(v["height"], v["round"], v["type"],
                                     v["validator_index"])
                 continue
-            time.sleep(self.gossip_sleep_s)
+            ps.wake.wait(self.gossip_sleep_s)
+            ps.wake.clear()
 
     def _pick_vote_for(self, ps: PeerRoundState, vote_set, height: int,
                        round_: int, type_: int) -> Optional[dict]:

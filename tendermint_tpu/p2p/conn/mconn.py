@@ -213,13 +213,22 @@ class MConnection:
                     if self._stopped:
                         return
                     pongs, self._pong_due = self._pong_due, 0
-                    ch = self._pick_channel()
-                    packet = None
-                    if ch is not None:
+                    # drain a BURST per wakeup: one packet per lock
+                    # cycle meant a cond round-trip (acquire, pick,
+                    # notify, release, write, reacquire) per 1-4KB of
+                    # block parts — on a shared-core testnet the wait/
+                    # notify bookkeeping alone profiled at ~12% of node
+                    # CPU. Priorities still hold: _pick_channel runs
+                    # per packet inside one acquisition.
+                    packets = []
+                    while len(packets) < 16:
+                        ch = self._pick_channel()
+                        if ch is None:
+                            break
                         payload, eof = ch.next_packet()
-                        packet = struct.pack(
+                        packets.append(struct.pack(
                             ">BBB", PACKET_MSG, ch.desc.id, 1 if eof else 0
-                        ) + payload
+                        ) + payload)
                         ch.recently_sent += len(payload)
                     self._cond.notify_all()  # wake senders blocked on queue
 
@@ -237,7 +246,7 @@ class MConnection:
                     self.link.write(bytes([PACKET_PING]))
                     self.send_monitor.update(1)
                     last_ping = now
-                if packet is not None:
+                for packet in packets:
                     self.link.write(packet)
                     self.send_monitor.update(len(packet))
                 # idle/death detection
