@@ -77,7 +77,7 @@ def verify_commit_100(n_vals: int = 100) -> dict:
     # this is dominated by the per-dispatch round trip (~100ms), not
     # device compute (~1ms for 100 sigs) — reported as-is.
     best = float("inf")
-    for _ in range(4):
+    for _ in range(3):
         t0 = time.perf_counter()
         vs.verify_commit("bench-commit", bid, 7, commit, verifier=jv)
         best = min(best, time.perf_counter() - t0)
@@ -140,15 +140,15 @@ def verify_commit_100(n_vals: int = 100) -> dict:
     dev_s = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(40):
+        for _ in range(30):
             out = ed.verify_from_bytes_best(*dargs)
         out.block_until_ready()
-        dev_s = min(dev_s, (time.perf_counter() - t0) / 40)
+        dev_s = min(dev_s, (time.perf_counter() - t0) / 30)
 
     sv = ScalarVerifier()
     t0 = time.perf_counter()
     reps = 0
-    while time.perf_counter() - t0 < 2.0:
+    while time.perf_counter() - t0 < 1.5:
         vs.verify_commit("bench-commit", bid, 7, commit, verifier=sv)
         reps += 1
     scalar_s = (time.perf_counter() - t0) / reps
@@ -268,8 +268,12 @@ def main() -> int:
         # the thresholds are calibrated for the default 10k commit;
         # a smaller manual `bench.py N` is tunnel-RTT-bound (~60-110ms
         # floor) and would never hit a down-scaled threshold — run the
-        # plain single round there instead of 3 futile 20s retries
-        n_rounds = 4 if m >= 10240 else 1
+        # plain single round there instead of futile 20s retries.
+        # Two rounds max (was four): sustained congestion phases show
+        # near-identical bests across every retry round (r5 rehearsal:
+        # 44.2/44.3/44.3/44.3 ms), so extra rounds bought ~90s of the
+        # driver budget and no signal
+        n_rounds = 2 if m >= 10240 else 1
         for rnd in range(n_rounds):
             dt_round = float("inf")
             for i in range(trials if rnd == 0 else 6):
@@ -510,18 +514,20 @@ def main() -> int:
             # config 5 at FULL scale: 1M headers x 64 validators,
             # streamed build (TPU batch signing) / timed certify
             # waves. Slice: everything left minus the big fastsync's
-            # floor (~240s: ~165s build + wave floor + baselines).
+            # full-scale need (~430s: ~20480-block build + timed waves
+            # + baselines) — VERDICT r5 ranks the 5000-tx fastsync
+            # first, so it keeps its full scale and lite_1m flexes
             return bench_lite.run_streamed(
                 int(os.environ.get("TM_BENCH_LITE_HEADERS", "1000000")),
                 64,
-                deadline=time.monotonic() + max(120.0, remaining() - 260))
+                deadline=time.monotonic() + max(120.0, remaining() - 430))
 
         def _testnet():
             import bench_testnet
             # engine arm (in-process, MockTicker-driven) AND the
             # real-socket arm (4 OS processes, TCP P2P + secret conns,
             # WS tx injection) side by side — VERDICT r3 item 5
-            out = bench_testnet.run(30, 4, 1000)
+            out = bench_testnet.run(24, 4, 1000)
             out["socket"] = bench_testnet.run_socket()
             return out
 

@@ -179,7 +179,11 @@ class ChainBuilder:
     def build(self, n: int) -> list:
         """Next n blocks. Applies through the app (headers embed real
         app hashes) but skips block validation — the builder made the
-        block, the sync arm is what validates."""
+        block, the sync arm is what validates. Signing stays PER BLOCK
+        (batching across blocks is impossible here: block h+1's header
+        embeds commit h's hash, which covers the signatures), but each
+        block's 64 identical-message signatures share one sign-bytes
+        encode."""
         from tendermint_tpu.state.execution import (exec_block_on_app,
                                                     update_state)
         from tendermint_tpu.types.block import BlockID, Commit
@@ -196,13 +200,17 @@ class ChainBuilder:
             block_id = BlockID(block.hash(), parts.header())
             out.append(block)
             precommits = []
+            msg = None
             for idx, val in enumerate(self.state.validators.validators):
                 v = Vote(validator_address=val.address,
                          validator_index=idx, height=h, round=0,
                          timestamp_ns=h * 10 ** 9 + 1,
                          type=VoteType.PRECOMMIT, block_id=block_id)
-                v.signature = self.signers[val.address](
-                    v.sign_bytes(self.gen.chain_id))
+                if msg is None:
+                    # one timestamp + one block id => every validator
+                    # signs identical canonical bytes for this block
+                    msg = v.sign_bytes(self.gen.chain_id)
+                v.signature = self.signers[val.address](msg)
                 precommits.append(v)
             self.last_commit = Commit(block_id, precommits)
             responses = exec_block_on_app(self.conns.consensus, block,
@@ -396,9 +404,10 @@ def run(n_blocks: int = 5120, n_vals: int = 64, n_txs: int = 32,
     # run will hit (each new batch shape costs a full TPU compile, which
     # would otherwise land inside the timed loop)
     sync_chain(gen, blocks, backend="auto")
-    # best-of-3: the shared TPU tunnel's load varies minute to minute
-    # (same policy as bench.py's headline)
-    out = max((sync_chain(gen, blocks, backend="auto") for _ in range(3)),
+    # best-of-2: the shared TPU tunnel's load varies minute to minute
+    # (same policy as bench.py's headline, one fewer rep — the arm is
+    # a continuity datapoint, not a flagship)
+    out = max((sync_chain(gen, blocks, backend="auto") for _ in range(2)),
               key=lambda o: o["blocks_per_sec"])
     out["build_seconds"] = round(build_s, 1)
     out["n_vals"] = n_vals
@@ -413,7 +422,7 @@ def run(n_blocks: int = 5120, n_vals: int = 64, n_txs: int = 32,
         # device = best-of-3 over the full chain (tunnel-load policy,
         # same as the headline), scalar = ONE run over a prefix slice
         # (flat per-block cost; full-length scalar would take minutes)
-        out["device_trials"] = 3
+        out["device_trials"] = 2
         out["scalar_trials"] = 1
         out["vs_scalar"] = round(
             out["blocks_per_sec"] / out_scalar["blocks_per_sec"], 2)

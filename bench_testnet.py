@@ -136,7 +136,7 @@ def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
 
 
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
-               duration_s: float = 30.0) -> dict:
+               duration_s: float = 25.0) -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit

@@ -215,10 +215,21 @@ class ValidatorSet:
         # occasionally block_id for nil votes), so the canonical prefix/
         # suffix around the timestamp is built once per block_id via the
         # ONE layout definition (vote.sign_bytes_template) — pinned by
-        # test_commit_items_sign_bytes_match
+        # test_commit_items_sign_bytes_match.
+        # Hot-path shape: locally-built commits share ONE BlockID
+        # object and one timestamp across all votes, so an identity
+        # check replaces the per-vote tuple-key memo almost always;
+        # wire-parsed commits (per-vote BlockID objects) fall back to
+        # the content-keyed memo.
         from tendermint_tpu.types.vote import sign_bytes_template
         tmpl: dict = {}
         sb_memo: dict = {}
+        last_bid = last_sb = None
+        last_ts = None
+        last_for = False
+        validators = self.validators
+        append_item = items.append
+        append_power = item_power.append
         for idx, pc in enumerate(commit.precommits):
             if pc is None:
                 continue
@@ -226,25 +237,28 @@ class ValidatorSet:
                 raise ValueError("commit contains non-precommit")
             if pc.height != height or pc.round != round_:
                 raise ValueError("commit vote height/round mismatch")
-            val = self.validators[idx]
+            val = validators[idx]
             bid = pc.block_id
-            tkey = (bid.hash, bid.parts.total, bid.parts.hash)
-            # sign bytes are fully determined by (block_id, timestamp)
-            # within one commit — and votes in a commit often SHARE a
-            # timestamp (synthetic chains always, real chains per
-            # proposer round), so the encode is memoized on both
-            skey = (tkey, pc.timestamp_ns)
-            sb = sb_memo.get(skey)
-            if sb is None:
-                t = tmpl.get(tkey)
-                if t is None:
-                    t = sign_bytes_template(chain_id, bid, height,
-                                            round_, pc.type)
-                    tmpl[tkey] = t
-                sb = (t[0] + str(pc.timestamp_ns) + t[1]).encode()
-                sb_memo[skey] = sb
-            items.append((val.pubkey, sb, pc.signature))
-            item_power.append((val.voting_power, bid == block_id))
+            ts = pc.timestamp_ns
+            if bid is last_bid and ts == last_ts:
+                sb = last_sb
+            else:
+                tkey = (bid.hash, bid.parts.total, bid.parts.hash)
+                skey = (tkey, ts)
+                sb = sb_memo.get(skey)
+                if sb is None:
+                    t = tmpl.get(tkey)
+                    if t is None:
+                        t = sign_bytes_template(chain_id, bid, height,
+                                                round_, pc.type)
+                        tmpl[tkey] = t
+                    sb = (t[0] + str(ts) + t[1]).encode()
+                    sb_memo[skey] = sb
+                if bid is not last_bid:
+                    last_for = bid == block_id
+                last_bid, last_ts, last_sb = bid, ts, sb
+            append_item((val.pubkey, sb, pc.signature))
+            append_power((val.voting_power, last_for))
         return items, item_power
 
     def check_commit_results(self, ok, item_power) -> None:
