@@ -177,6 +177,76 @@ def verify_commit_100(n_vals: int = 100) -> dict:
     }
 
 
+def bench_verifier_json(path: str = "BENCH_verifier.json",
+                        batch_sizes=(512, 2048, 8192), reps: int = 3,
+                        pubs=None, msgs=None, sigs=None,
+                        verifier=None) -> dict:
+    """First point of the bench trajectory: sig-verifies/sec at a few
+    batch sizes, read FROM THE TELEMETRY HISTOGRAMS
+    (tm_verifier_dispatch_seconds / tm_verifier_sigs_total) rather than
+    ad-hoc timers — so the artifact doubles as a live check that the
+    observability layer measures the same thing the bench does."""
+    import numpy as np
+    from tendermint_tpu import telemetry
+    from tendermint_tpu.models.verifier import BatchVerifier
+
+    if pubs is None:
+        from bench_util import fast_signer
+        from tendermint_tpu.utils import ed25519_ref as ref
+        n_max = max(batch_sizes)
+        pubs, msgs, sigs = [], [], []
+        for i in range(n_max):
+            seed = (i + 1).to_bytes(32, "little")
+            pubs.append(ref.public_key(seed))
+            m = b"bench-verifier-%d" % i
+            msgs.append(m)
+            sigs.append(fast_signer(seed)(m))
+    v = verifier if verifier is not None else BatchVerifier("jax")
+    was_enabled = telemetry.enabled()
+    telemetry.set_enabled(True)
+    points = []
+    try:
+        for bs in batch_sizes:
+            if bs > len(pubs):
+                continue
+            items = list(zip(pubs[:bs], msgs[:bs], sigs[:bs]))
+            for _ in range(2):  # compile + predecomp-cache fill
+                assert bool(np.asarray(v.verify(items)).all())
+            d0 = telemetry.value("verifier_dispatch_seconds",
+                                 {"backend": "jax"})
+            s0 = telemetry.value("verifier_sigs_total",
+                                 {"backend": "jax"})
+            for _ in range(reps):
+                assert bool(np.asarray(v.verify(items)).all())
+            d1 = telemetry.value("verifier_dispatch_seconds",
+                                 {"backend": "jax"})
+            s1 = telemetry.value("verifier_sigs_total",
+                                 {"backend": "jax"})
+            dt = d1["sum"] - d0["sum"]
+            n_sigs = s1 - s0
+            points.append({
+                "batch_size": bs,
+                "reps": reps,
+                "verifies_per_sec":
+                    round(n_sigs / dt, 1) if dt > 0 else None,
+                "dispatch_ms_mean": round(dt / reps * 1e3, 3),
+            })
+    finally:
+        telemetry.set_enabled(was_enabled)
+    import jax
+    doc = {
+        "metric": "verifier_throughput_by_batch",
+        "unit": "verifies/sec",
+        "backend": jax.devices()[0].platform,
+        "source": "telemetry histograms (tm_verifier_dispatch_seconds, "
+                  "tm_verifier_sigs_total)",
+        "points": points,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def main() -> int:
     import numpy as np
     import jax
@@ -359,7 +429,20 @@ def main() -> int:
 
     base_rate = scalar_baseline_rate(pubs, msgs, sigs)
 
+    # BENCH_verifier.json satellite: per-batch-size throughput from the
+    # telemetry histograms (reuses the already-warmed verifier + items;
+    # a failure must not cost the headline artifact)
+    try:
+        sizes = tuple(int(b) for b in os.environ.get(
+            "TM_BENCH_VERIFIER_SIZES", "512,2048,8192").split(","))
+        verifier_json = bench_verifier_json(
+            batch_sizes=sizes, pubs=pubs, msgs=msgs, sigs=sigs,
+            verifier=jv)
+    except Exception as e:  # pragma: no cover
+        verifier_json = {"error": f"{type(e).__name__}: {e}"}
+
     extra = {
+        "bench_verifier_json": verifier_json,
         "backend": jax.devices()[0].platform,
         "batch": n,
         "device_ms_per_batch": round(dt * 1e3, 2),
@@ -562,4 +645,11 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--verifier-json" in sys.argv:
+        # standalone quick mode: only the BENCH_verifier.json satellite
+        _sizes = tuple(int(b) for b in os.environ.get(
+            "TM_BENCH_VERIFIER_SIZES", "512,2048,8192").split(","))
+        print(json.dumps(bench_verifier_json(batch_sizes=_sizes)),
+              flush=True)
+        sys.exit(0)
     sys.exit(main())

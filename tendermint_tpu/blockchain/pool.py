@@ -14,7 +14,21 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from tendermint_tpu import telemetry
 from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+
+# Fast-sync window health: how many completed blocks sit buffered ahead
+# of the apply height (the paper's blocks/sec number starves when this
+# gauge hits 0 — the verifier is outrunning the network).
+_m_window_fill = telemetry.gauge(
+    "fastsync_window_fill",
+    "Completed blocks buffered ahead of the apply height")
+_m_blocks = telemetry.counter(
+    "fastsync_blocks_received_total", "Blocks accepted from peers")
+_m_requests = telemetry.counter(
+    "fastsync_requests_total", "Block requests sent to peers")
+_m_height = telemetry.gauge(
+    "fastsync_height", "Next height the fast-sync pool will apply")
 
 MAX_PENDING_REQUESTS = 1000       # blockchain/pool.go:31
 MAX_PENDING_PER_PEER = 50
@@ -88,6 +102,7 @@ class BlockPool:
         self.peers: Dict[str, BpPeer] = {}
         self.requests: Dict[int, _Request] = {}
         self._started_at = time.monotonic()
+        self._n_filled = 0  # requests holding a completed block (gauge)
 
     # ----------------------------------------------------------------- peers
 
@@ -144,6 +159,8 @@ class BlockPool:
                 self.requests[next_h] = req
                 peer.on_request()
                 to_send.append((peer.id, next_h))
+        if to_send:
+            _m_requests.inc(len(to_send))
         for peer_id, h in to_send:
             if not self.send_request(peer_id, h):
                 with self._lock:
@@ -202,6 +219,9 @@ class BlockPool:
             p = self.peers.get(peer_id)
             if p is not None:
                 p.on_block(size)
+            self._n_filled += 1
+            _m_blocks.inc()
+            _m_window_fill.set(self._n_filled)
             return True
 
     def peek_two_blocks(self) -> tuple:
@@ -234,8 +254,12 @@ class BlockPool:
     def pop_request(self) -> None:
         """Advance past a verified + applied block."""
         with self._lock:
-            self.requests.pop(self.height, None)
+            req = self.requests.pop(self.height, None)
             self.height += 1
+            if req is not None and req.block is not None:
+                self._n_filled = max(0, self._n_filled - 1)
+            _m_window_fill.set(self._n_filled)
+            _m_height.set(self.height)
 
     def redo_request(self, height: int) -> List[str]:
         """Bad block: reassign this height (and its successor — the lying
@@ -249,9 +273,12 @@ class BlockPool:
                     if req.peer_id:
                         bad.append(req.peer_id)
                         self.peers.pop(req.peer_id, None)
+                    if req.block is not None:
+                        self._n_filled = max(0, self._n_filled - 1)
                     fresh = _Request(h, "")
                     fresh.peer_id = ""
                     self.requests[h] = fresh
+            _m_window_fill.set(self._n_filled)
         return bad
 
     def is_caught_up(self) -> bool:

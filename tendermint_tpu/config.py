@@ -27,6 +27,11 @@ class BaseConfig:
     # the device mesh (models/verifier.py)
     verifier_backend: str = "auto"
     verifier_mesh: str = "auto"
+    # telemetry plane (telemetry/): metrics + tracing on by default; the
+    # namespace prefixes every exposed metric (tm_verifier_batch_size).
+    # Env TM_TPU_TELEMETRY=off overrides `telemetry` unconditionally.
+    telemetry: bool = True
+    telemetry_namespace: str = "tm"
 
 
 @dataclass

@@ -23,6 +23,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
+from tendermint_tpu import telemetry
 from tendermint_tpu.config import ConsensusConfig
 from tendermint_tpu.consensus.rstate import HeightVoteSet, RoundState, Step
 from tendermint_tpu.consensus.ticker import MockTicker, TimeoutInfo, TimeoutTicker
@@ -42,6 +43,26 @@ from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSet
 
 class ConsensusFailure(Exception):
     """Unrecoverable consensus fault (reference panics / kills process)."""
+
+
+# The consensus timeline the paper's block-rate numbers decompose into:
+# where heights/rounds sit now, how long rounds take end to end, and how
+# often each step fires (a precommit-wait-heavy profile means votes are
+# arriving late — usually a verifier or gossip problem, not consensus).
+_m_height = telemetry.gauge(
+    "consensus_height", "Current consensus height")
+_m_round = telemetry.gauge(
+    "consensus_round", "Current consensus round within the height")
+_m_steps = telemetry.counter(
+    "consensus_steps_total", "Step transitions by step name", ("step",))
+_m_round_dur = telemetry.histogram(
+    "consensus_round_duration_seconds",
+    "enterNewRound -> enterCommit wall time per committed round")
+_m_commits = telemetry.counter(
+    "consensus_commits_total", "Blocks finalized by this node")
+_m_block_txs = telemetry.histogram(
+    "consensus_block_txs", "Transactions per finalized block",
+    buckets=telemetry.POW2_BUCKETS)
 
 
 class ConsensusState:
@@ -75,6 +96,11 @@ class ConsensusState:
         self.fatal_error = None
         self._processing = False
         self._stopped = False
+        # telemetry timeline anchors (perf_counter stamps): when the
+        # current round began, and the still-open step interval the next
+        # _new_step closes as one Chrome-trace complete event
+        self._round_t0 = 0.0
+        self._step_open = None  # (step_name, height, round, t0)
 
         self.ticker = ticker_factory(self._on_timeout_fire)
 
@@ -249,6 +275,19 @@ class ConsensusState:
 
     def _new_step(self) -> None:
         self.n_steps += 1
+        # replayed steps (WAL catchup/handshake) are not new consensus
+        # progress — they must not inflate counters or the timeline
+        if telemetry.enabled() and not self.replay_mode:
+            now = time.perf_counter()
+            if self._step_open is not None:
+                name, h, r, t0 = self._step_open
+                telemetry.TRACER.complete(
+                    f"cs:{name}", t0, now, height=h, round=r)
+            rs = self.rs
+            self._step_open = (rs.step.name, rs.height, rs.round, now)
+            _m_steps.labels(rs.step.name).inc()
+            _m_height.set(rs.height)
+            _m_round.set(rs.round)
         if not self.replay_mode:
             self.wal.save({"type": "round_state",
                            **self.rs.round_state_event_obj()})
@@ -304,6 +343,7 @@ class ConsensusState:
             validators.increment_accum(round_ - rs.round)
         rs.round = round_
         rs.step = Step.NEW_ROUND
+        self._round_t0 = time.perf_counter()
         rs.validators = validators
         if round_ != 0:
             rs.proposal = None
@@ -594,6 +634,8 @@ class ConsensusState:
         rs.step = Step.COMMIT
         rs.commit_round = commit_round
         rs.commit_time_ns = time.time_ns()
+        if telemetry.enabled() and self._round_t0 and not self.replay_mode:
+            _m_round_dur.observe(time.perf_counter() - self._round_t0)
         self._new_step()
         self._try_finalize_commit(height)
 
@@ -647,6 +689,13 @@ class ConsensusState:
 
         if self.decided_hook is not None:
             self.decided_hook(block)
+
+        if telemetry.enabled() and not self.replay_mode:
+            _m_commits.inc()
+            _m_block_txs.observe(len(block.data.txs))
+            telemetry.instant("cs:finalize_commit", height=height,
+                              round=rs.commit_round,
+                              txs=len(block.data.txs))
 
         self._update_to_state(new_state)
         self._schedule_round0()

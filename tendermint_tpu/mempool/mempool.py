@@ -28,8 +28,20 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from tendermint_tpu import telemetry
 from tendermint_tpu.abci.types import ResultCheckTx
 from tendermint_tpu.mempool.clist import CList
+
+_m_size = telemetry.gauge(
+    "mempool_size", "Pending transactions in the mempool")
+_m_added = telemetry.counter(
+    "mempool_txs_added_total", "Transactions accepted by CheckTx")
+_m_rejected = telemetry.counter(
+    "mempool_txs_rejected_total",
+    "Transactions rejected at admission, by reason", ("reason",))
+_m_removed = telemetry.counter(
+    "mempool_txs_removed_total",
+    "Transactions removed after admission, by reason", ("reason",))
 
 
 @dataclass
@@ -117,6 +129,7 @@ class Mempool:
             self.cache.reset()
             self.txs.clear()
             self._tx_elements.clear()
+            _m_size.set(0)
 
     def close(self) -> None:
         if self._wal_file is not None:
@@ -170,13 +183,16 @@ class Mempool:
         notify = False
         with self.proxy_mtx:
             if self.size() >= self.max_size:
+                _m_rejected.labels("full").inc()
                 raise MempoolFull(self.size(), self.max_size)
             # a tx can still be pending after its cache entry was evicted;
             # re-admitting it would orphan the original CList element
             if tx in self._tx_elements:
                 self.cache.push(tx)
+                _m_rejected.labels("duplicate").inc()
                 raise TxAlreadyInCache(tx.hex())
             if not self.cache.push(tx):
+                _m_rejected.labels("duplicate").inc()
                 raise TxAlreadyInCache(tx.hex())
             if self._wal_file is not None and not _from_wal:
                 self._wal_file.write(struct.pack(">I", len(tx)) + tx)
@@ -186,10 +202,14 @@ class Mempool:
                 self.counter += 1
                 mtx = MempoolTx(self.counter, self.height, tx)
                 self._tx_elements[tx] = self.txs.push_back(mtx)
+                if telemetry.enabled():
+                    _m_added.inc()
+                    _m_size.set(len(self.txs))
                 notify = self._mark_txs_available()
             else:
                 # ineligible tx: forget it so a future (valid) resubmit works
                 self.cache.remove(tx)
+                _m_rejected.labels("invalid").inc()
         if notify:
             self.txs_available_hook()
         return res
@@ -228,9 +248,12 @@ class Mempool:
             el = self._tx_elements.pop(tx, None)
             if el is not None:
                 self.txs.remove(el)
+                _m_removed.labels("committed").inc()
             # committed txs stay in cache: re-submission is a dup
         if self.recheck and len(self.txs) > 0:
             self._recheck_txs()
+        if telemetry.enabled():
+            _m_size.set(len(self.txs))
         self._rewrite_wal()
         if self._mark_txs_available():
             self.txs_available_hook()
@@ -245,3 +268,4 @@ class Mempool:
                 self.txs.remove(el)
                 self._tx_elements.pop(tx, None)
                 self.cache.remove(tx)
+                _m_removed.labels("recheck").inc()

@@ -35,9 +35,33 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Sequence
 
 import numpy as np
+
+from tendermint_tpu import telemetry
+
+# The paper's headline metric is sig-verifies/sec/chip; these families
+# record exactly what that decomposes into: how big the batches arriving
+# at the boundary are, which backend the routing policy picked, how full
+# the padded device chunks run, and the dispatch->resolve wall time
+# (docs/observability.md has the catalog).
+_m_batch_size = telemetry.histogram(
+    "verifier_batch_size", "Signatures per verify() call",
+    buckets=telemetry.POW2_BUCKETS)
+_m_calls = telemetry.counter(
+    "verifier_calls_total", "verify() calls by chosen backend",
+    ("backend",))
+_m_sigs = telemetry.counter(
+    "verifier_sigs_total", "Signatures verified by backend", ("backend",))
+_m_dispatch = telemetry.histogram(
+    "verifier_dispatch_seconds",
+    "Wall time from verify dispatch to resolved verdicts", ("backend",))
+_m_occupancy = telemetry.histogram(
+    "verifier_chunk_occupancy",
+    "Per-chunk fill ratio vs the padded power-of-two bucket",
+    buckets=telemetry.RATIO_BUCKETS)
 
 # Per-dispatch chunk. The fused pallas kernel tiles batches internally
 # (512/VMEM tile), so big dispatches amortize launch overhead; the sweep
@@ -192,6 +216,8 @@ class BatchVerifier:
         if n == 0:
             out0 = np.zeros(0, np.bool_)
             return lambda: out0
+        t_dispatch = time.perf_counter()
+        _m_batch_size.observe(n)
         use_jax = self.backend == "jax" or (
             self.backend == "auto" and n > self.auto_threshold)
         if not use_jax:
@@ -199,6 +225,11 @@ class BatchVerifier:
             from tendermint_tpu.types.keys import verify_any
             out1 = np.array([verify_any(p, m, s) for p, m, s in items],
                             np.bool_)
+            if telemetry.enabled():
+                _m_calls.labels("python").inc()
+                _m_sigs.labels("python").inc(n)
+                _m_dispatch.labels("python").observe(
+                    time.perf_counter() - t_dispatch)
             return lambda: out1
         # fast path: the whole host prep (classification, length/s<L
         # checks, SHA-512 + mod-L) in one native call, GIL released —
@@ -211,6 +242,7 @@ class BatchVerifier:
             if not self._mesh_resolved:
                 self._resolve_mesh()
             self.stats["jax_sigs"] += n
+            self._record_jax_dispatch(n)
             pk, rb, sb, hb, pre = prep
             pending = []
             for lo in range(0, n, BATCH_CHUNK):
@@ -219,7 +251,7 @@ class BatchVerifier:
                     pk[lo:hi], rb[lo:hi], sb[lo:hi], hb[lo:hi],
                     kernel=self.kernel, min_bucket=self._min_bucket)
                 pending.append((lo, hi, res, pre[lo:hi]))
-            return self._make_resolver(n, pending)
+            return self._make_resolver(n, pending, t_dispatch=t_dispatch)
         # mixed-key routing: 33-byte compressed-SEC1 pubkeys are
         # secp256k1 — verified on host (off the TPU hot path by design,
         # types/keys.py); everything else goes to the ed25519 device
@@ -257,6 +289,7 @@ class BatchVerifier:
         if not self._mesh_resolved:
             self._resolve_mesh()
         self.stats["jax_sigs"] += n
+        self._record_jax_dispatch(n)
         pubkeys = [it[0] for it in items]
         msgs = [it[1] for it in items]
         sigs = [it[2] for it in items]
@@ -267,10 +300,25 @@ class BatchVerifier:
                 pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel,
                 min_bucket=self._min_bucket)
             pending.append((lo, hi, res, pre))
-        return self._make_resolver(n, pending)
+        return self._make_resolver(n, pending, t_dispatch=t_dispatch)
+
+    def _record_jax_dispatch(self, n: int) -> None:
+        """Batch/backend/occupancy samples for one device dispatch. The
+        occupancy a chunk actually runs at is its size over the padded
+        power-of-two bucket ed25519._bucket routes it to — low values
+        mean the device is hashing padding."""
+        if not telemetry.enabled():
+            return
+        from tendermint_tpu.ops import ed25519
+        _m_calls.labels("jax").inc()
+        _m_sigs.labels("jax").inc(n)
+        for lo in range(0, n, BATCH_CHUNK):
+            c = min(lo + BATCH_CHUNK, n) - lo
+            _m_occupancy.observe(
+                c / ed25519._bucket(c, min_size=self._min_bucket))
 
     @staticmethod
-    def _make_resolver(n: int, pending):
+    def _make_resolver(n: int, pending, t_dispatch: float = 0.0):
         def resolve() -> np.ndarray:
             out = np.zeros(n, np.bool_)
             if len(pending) > 1:
@@ -280,6 +328,9 @@ class BatchVerifier:
                 arrs = [np.asarray(pending[0][2])]
             for (lo, hi, _res, pre), arr in zip(pending, arrs):
                 out[lo:hi] = arr[:hi - lo] & pre
+            if t_dispatch and telemetry.enabled():
+                _m_dispatch.labels("jax").observe(
+                    time.perf_counter() - t_dispatch)
             return out
 
         return resolve

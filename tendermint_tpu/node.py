@@ -44,6 +44,14 @@ class Node:
         self.config = config
         self.gen_doc = gen_doc
 
+        # telemetry wiring BEFORE any instrumented subsystem runs (the
+        # handshake below already drives the verifier); env
+        # TM_TPU_TELEMETRY wins over the config knob inside configure()
+        from tendermint_tpu import telemetry
+        telemetry.configure(
+            enabled=getattr(config.base, "telemetry", True),
+            namespace=getattr(config.base, "telemetry_namespace", "tm"))
+
         def db_path(name):
             if in_memory:
                 return None

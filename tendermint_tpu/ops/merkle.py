@@ -28,13 +28,25 @@ import struct
 
 import numpy as np
 
+from tendermint_tpu import telemetry
+
 # jax (and ops.sha256, which pulls it in) is imported LAZILY inside the
 # device functions: merkle is imported by the core data model
 # (types/block.py), and a plain CPU node — every e2e/crash-matrix
 # subprocess — must not pay the multi-second jax import for host-side
-# hashing it never uses.
+# hashing it never uses. (telemetry is stdlib-only and safe here.)
 
 EMPTY_DIGEST = b"\x00" * 32  # padding leaf
+
+# Each public root/proof entry point counts once; `impl` says whether
+# the native C++ tree builder served it or the hashlib fallback ran.
+_m_roots = telemetry.counter(
+    "merkle_roots_total", "Merkle roots computed on host", ("impl",))
+_m_leaves = telemetry.histogram(
+    "merkle_leaves", "Leaves per host-side Merkle root",
+    buckets=telemetry.POW2_BUCKETS)
+_m_proofs = telemetry.counter(
+    "merkle_proofs_total", "Merkle proofs computed on host")
 
 
 # ---------------------------------------------------------------------------
@@ -67,6 +79,9 @@ def root_host(items: list[bytes]) -> bytes:
     from tendermint_tpu import native
     out = native.merkle_root(items)
     if out is not None:
+        if telemetry.enabled():
+            _m_roots.labels("native").inc()
+            _m_leaves.observe(len(items))
         return out
     return root_from_digests_host([leaf_hash(it) for it in items])
 
@@ -78,11 +93,15 @@ def root_from_digests_host(digests) -> bytes:
     n = len(digests) // 32 if flat else len(digests)
     if n == 0:
         return _final_hash(0, EMPTY_DIGEST)
+    if telemetry.enabled():
+        _m_leaves.observe(n)
     from tendermint_tpu import native
     out = native.merkle_root_from_digests(
         digests if flat else list(digests))
     if out is not None:
+        _m_roots.labels("native").inc()
         return out
+    _m_roots.labels("host").inc()
     if flat:
         digests = [bytes(digests[32 * i:32 * (i + 1)]) for i in range(n)]
     level = list(digests) + [EMPTY_DIGEST] * (_padded_size(n) - n)
@@ -134,6 +153,7 @@ def proof_host(items: list[bytes], index: int):
     """Returns (root, aunts) — aunts leaf-up, each 32 bytes."""
     n = len(items)
     assert 0 <= index < n
+    _m_proofs.inc()
     from tendermint_tpu import native
     native_out = native.merkle_proof(items, index)
     if native_out is not None:

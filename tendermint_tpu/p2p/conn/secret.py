@@ -25,14 +25,25 @@ import struct
 import threading
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.hashes import SHA256
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+# `cryptography` (OpenSSL) is OPTIONAL: its module-top import used to
+# kill collection of every test file that transitively imports the p2p
+# stack on containers without the package. When absent, the RFC-exact
+# pure-python fallback in purecrypto.py serves the same wire protocol
+# (X25519 + HKDF-SHA256 + ChaCha20Poly1305), so nodes with and without
+# OpenSSL interoperate — the fallback is just slower (~1 ms/KB frame).
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    HAVE_CRYPTOGRAPHY = False
 
+from tendermint_tpu.p2p.conn import purecrypto
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.keys import PubKey
 
@@ -42,15 +53,38 @@ _TAG = 16             # poly1305 tag
 
 def _hkdf(secret: bytes, info: bytes, n: int) -> bytes:
     """RFC 5869 HKDF-SHA256."""
-    return HKDF(algorithm=SHA256(), length=n, salt=None,
-                info=info).derive(secret)
+    if HAVE_CRYPTOGRAPHY:
+        return HKDF(algorithm=SHA256(), length=n, salt=None,
+                    info=info).derive(secret)
+    return purecrypto.hkdf_sha256(secret, info, n)
+
+
+def _aead(key: bytes):
+    if HAVE_CRYPTOGRAPHY:
+        return ChaCha20Poly1305(key)
+    return purecrypto.ChaCha20Poly1305(key)
+
+
+def _eph_keypair():
+    """-> (private_handle, public32). The private handle is whatever
+    _dh() below expects for the active backend."""
+    if HAVE_CRYPTOGRAPHY:
+        priv = X25519PrivateKey.generate()
+        return priv, priv.public_key().public_bytes_raw()
+    return purecrypto.x25519_keypair()
+
+
+def _dh(priv, their_pub32: bytes) -> bytes:
+    if HAVE_CRYPTOGRAPHY:
+        return priv.exchange(X25519PublicKey.from_public_bytes(their_pub32))
+    return purecrypto.x25519(priv, their_pub32)
 
 
 class _Cipher:
     """One direction: ChaCha20Poly1305 with a 96-bit counter nonce."""
 
     def __init__(self, key: bytes):
-        self.aead = ChaCha20Poly1305(key)
+        self.aead = _aead(key)
         self.nonce = 0
 
     def _next_nonce(self) -> bytes:
@@ -83,12 +117,11 @@ class SecretConnection:
 
     @classmethod
     def make(cls, conn, node_key) -> "SecretConnection":
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes_raw()
+        eph_priv, eph_pub = _eph_keypair()
         conn.sendall(eph_pub)
         their_eph = _read_exact(conn, 32)
 
-        secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
+        secret = _dh(eph_priv, their_eph)
         lo, hi = sorted((eph_pub, their_eph))
         keys = _hkdf(secret, b"tendermint_tpu/secret/" + lo + hi, 96)
         k_lo, k_hi, challenge = keys[:32], keys[32:64], keys[64:]

@@ -29,7 +29,17 @@ from tendermint_tpu.p2p.peer import (
     read_handshake_msg,
     write_handshake_msg,
 )
+from tendermint_tpu import telemetry
 from tendermint_tpu.types import encoding
+
+_m_peers = telemetry.gauge(
+    "p2p_peers", "Connected peers")
+_m_sent = telemetry.counter(
+    "p2p_msgs_sent_total", "Messages enqueued to peers, by channel",
+    ("channel",))
+_m_recv = telemetry.counter(
+    "p2p_msgs_recv_total", "Messages received from peers, by channel",
+    ("channel",))
 
 RECONNECT_ATTEMPTS = 20
 RECONNECT_BASE_S = 1.0          # exponential backoff base (switch.go:26-33)
@@ -290,6 +300,7 @@ class Switch:
         if not self.peers.add(peer):
             link.close()
             raise SwitchError(f"duplicate peer {peer.id}")
+        _m_peers.set(self.peers.size())
         with self._lock:
             # registry for join-on-stop: a recv thread that removes its
             # own peer from the PeerSet (stop_peer_for_error race) must
@@ -320,6 +331,7 @@ class Switch:
             self.stop_peer_for_error(
                 peer, ValueError(f"msg on unknown channel {ch_id:#x}"))
             return
+        _m_recv.labels(f"{ch_id:#04x}").inc()
         reactor.receive(ch_id, peer, msg)
 
     def _peer_error(self, peer: Peer, err: Exception) -> None:
@@ -350,6 +362,7 @@ class Switch:
         if not self.peers.has(peer.id):
             return
         self.peers.remove(peer)
+        _m_peers.set(self.peers.size())
         peer.stop(join=join)
         for reactor in self.reactors.values():
             try:
@@ -401,7 +414,10 @@ class Switch:
 
     def broadcast(self, ch_id: int, msg: bytes) -> None:
         """Best-effort fan-out (switch.go:210-227)."""
-        for peer in self.peers.list():
+        peers = self.peers.list()
+        if peers and telemetry.enabled():
+            _m_sent.labels(f"{ch_id:#04x}").inc(len(peers))
+        for peer in peers:
             peer.try_send(ch_id, msg)
 
     def broadcast_obj(self, ch_id: int, obj: dict) -> None:

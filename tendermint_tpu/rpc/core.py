@@ -96,6 +96,7 @@ class RPCCore:
             "abci_info": self.abci_info,
             "tx": self.tx,
             "tx_search": self.tx_search,
+            "metrics": self.metrics,
         }
         if self.env.unsafe:
             r.update({
@@ -105,6 +106,7 @@ class RPCCore:
                 "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
                 "unsafe_stop_cpu_profiler": self.unsafe_stop_cpu_profiler,
                 "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
+                "unsafe_dump_trace": self.unsafe_dump_trace,
             })
         return r
 
@@ -377,6 +379,23 @@ class RPCCore:
         prof.dump_stats(filename)
         return {"written": filename}
 
+    def metrics(self) -> dict:
+        """JSON-RPC view of the telemetry state. Prometheus scrapers use
+        the raw GET /metrics path on the same listener instead (served
+        as text/plain by the server, not this handler)."""
+        from tendermint_tpu import telemetry
+        return {"enabled": telemetry.enabled(),
+                "namespace": telemetry.namespace(),
+                "exposition": telemetry.expose()}
+
+    def unsafe_dump_trace(self, filename: str = "") -> dict:
+        """Write the in-memory consensus/verifier timeline as
+        Chrome-trace JSON (chrome://tracing, ui.perfetto.dev)."""
+        from tendermint_tpu import telemetry
+        filename = filename or "consensus_trace.json"
+        n = len(telemetry.TRACER.events())
+        return {"written": telemetry.dump_trace(filename), "events": n}
+
     def unsafe_write_heap_profile(self, filename: str = "") -> dict:
         """First call arms tracemalloc and returns started=true (there is
         nothing to snapshot yet); later calls write the snapshot."""
@@ -497,10 +516,14 @@ class RPCCore:
 
 def make_server(env: RPCEnv):
     """Assemble an RPCServer with the full route table."""
+    from tendermint_tpu import telemetry
     from tendermint_tpu.rpc.server import RPCServer
     core = RPCCore(env)
     server = RPCServer()
     server.register_all(core.routes())
     for name, fn in core.ws_routes().items():
         server.register(name, fn, ws_only=True)
+    # raw Prometheus scrape path; serves the (possibly empty) registry
+    # even when telemetry is disabled so scrapers never see a 404 flap
+    server.metrics_provider = telemetry.expose
     return server, core

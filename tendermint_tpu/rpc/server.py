@@ -287,6 +287,10 @@ class RPCServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._ws_conns: list = []
         self.max_http_conns = max_http_conns
+        # when set, GET /metrics serves this callable's text verbatim as
+        # Prometheus exposition (text/plain) instead of JSON-RPC routing
+        # — scrapers speak raw HTTP, not JSON-RPC envelopes
+        self.metrics_provider: Optional[Callable[[], str]] = None
 
     def register(self, name: str, fn: Callable, ws_only: bool = False) -> None:
         self.funcs[name] = RPCFunc(fn, ws_only=ws_only)
@@ -362,6 +366,22 @@ class RPCServer:
                     self._upgrade_websocket()
                     return
                 url = urlparse(self.path)
+                if url.path == "/metrics" and \
+                        server.metrics_provider is not None:
+                    try:
+                        body = server.metrics_provider().encode()
+                    except Exception as e:
+                        self._reply(_rpc_response(None, error=RPCError(
+                            -32603, f"metrics provider failed: {e}")), 500)
+                        return
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 method = url.path.strip("/")
                 if method == "":
                     # route listing, like the reference's index page

@@ -146,8 +146,14 @@ class PrivKey:
 # ---------------------------------------------------------------- secp256k1
 
 def _ec():
-    from cryptography.hazmat.primitives.asymmetric import ec
-    return ec
+    """OpenSSL EC bindings, or None when `cryptography` is absent (the
+    pure-python utils/secp256k1_ref fallback serves the same DER/SEC1
+    wire format)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric import ec
+        return ec
+    except ImportError:
+        return None
 
 
 @dataclass(frozen=True)
@@ -161,9 +167,11 @@ class Secp256k1PubKey:
         return address_of(self.secp256k1)
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
+        ec = _ec()
+        if ec is None:
+            from tendermint_tpu.utils import secp256k1_ref
+            return secp256k1_ref.verify(self.secp256k1, msg, sig)
         try:
-            ec = _ec()
-            from cryptography.exceptions import InvalidSignature
             from cryptography.hazmat.primitives import hashes
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 ec.SECP256K1(), self.secp256k1)
@@ -208,15 +216,23 @@ class Secp256k1PrivKey:
     def pubkey(self) -> Secp256k1PubKey:
         pk = self.__dict__.get("_pub")
         if pk is None:
-            from cryptography.hazmat.primitives import serialization
-            pk = Secp256k1PubKey(self._key().public_key().public_bytes(
-                serialization.Encoding.X962,
-                serialization.PublicFormat.CompressedPoint))
+            if _ec() is None:
+                from tendermint_tpu.utils import secp256k1_ref
+                pk = Secp256k1PubKey(secp256k1_ref.pubkey_of(self.seed))
+            else:
+                from cryptography.hazmat.primitives import serialization
+                pk = Secp256k1PubKey(
+                    self._key().public_key().public_bytes(
+                        serialization.Encoding.X962,
+                        serialization.PublicFormat.CompressedPoint))
             self.__dict__["_pub"] = pk
         return pk
 
     def sign(self, msg: bytes) -> bytes:
         ec = _ec()
+        if ec is None:
+            from tendermint_tpu.utils import secp256k1_ref
+            return secp256k1_ref.sign(self.seed, msg)
         from cryptography.hazmat.primitives import hashes
         return self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
 

@@ -1,0 +1,137 @@
+"""Pure-python SecretConnection fallback primitives vs RFC test vectors
+(the interop contract with the OpenSSL-backed path)."""
+
+import pytest
+
+from tendermint_tpu.p2p.conn import purecrypto as pc
+
+
+def test_x25519_rfc7748_vector():
+    k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"
+                      "62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex("e6db6867583030db3594c1a424b15f7c"
+                      "726624ec26b3353b10a903a6d0ab1c4c")
+    assert pc.x25519(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f"
+        "32eccf03491c71f754b4075577a28552")
+
+
+def test_x25519_dh_agreement_rfc7748():
+    a = bytes.fromhex("77076d0a7318a57d3c16c17251b26645"
+                      "df4c2f87ebc0992ab177fba51db92c2a")
+    b = bytes.fromhex("5dab087e624a8a4b79e17f8b83800ee6"
+                      "6f3bb1292618b6fd1c2f8b27ff88e0eb")
+    a_pub = pc.x25519(a, pc.X25519_BASE)
+    b_pub = pc.x25519(b, pc.X25519_BASE)
+    assert a_pub == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a"
+        "0dbf3a0d26381af4eba4a98eaa9b4e6a")
+    shared = bytes.fromhex("4a5d9d5ba4ce2de1728e3bf480350f25"
+                           "e07e21c947d19e3376f09b3c1e161742")
+    assert pc.x25519(a, b_pub) == shared
+    assert pc.x25519(b, a_pub) == shared
+
+
+def test_hkdf_sha256_rfc5869_case1():
+    okm = pc.hkdf_sha256(
+        bytes.fromhex("0b" * 22),
+        info=bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"),
+        length=42,
+        salt=bytes.fromhex("000102030405060708090a0b0c"))
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56"
+        "ecc4c5bf34007208d5b887185865")
+
+
+def test_chacha20poly1305_rfc8439_vector():
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (b"Ladies and Gentlemen of the class of '99: If I could "
+          b"offer you only one tip for the future, sunscreen would "
+          b"be it.")
+    ct = pc.ChaCha20Poly1305(key).encrypt(nonce, pt, aad)
+    assert ct[:16] == bytes.fromhex("d31a8d34648e60db7b86afbc53ef7ec2")
+    assert ct[-16:] == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert pc.ChaCha20Poly1305(key).decrypt(nonce, ct, aad) == pt
+
+
+def test_chacha20poly1305_rejects_tampering():
+    key = b"\x01" * 32
+    nonce = b"\x00" * 12
+    box = pc.ChaCha20Poly1305(key)
+    ct = box.encrypt(nonce, b"payload", b"")
+    with pytest.raises(pc.InvalidTag):
+        box.decrypt(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), b"")
+    with pytest.raises(pc.InvalidTag):
+        box.decrypt(nonce, ct, b"wrong aad")
+    with pytest.raises(pc.InvalidTag):
+        box.decrypt(nonce, ct[:8], b"")  # shorter than a tag
+
+
+def test_secp256k1_ref_rfc6979_vector():
+    """Deterministic-nonce ECDSA vector (key=1, 'Satoshi Nakamoto' —
+    the canonical published secp256k1/SHA-256 RFC 6979 case)."""
+    import hashlib
+
+    from tendermint_tpu.utils import secp256k1_ref as sr
+    h1 = hashlib.sha256(b"Satoshi Nakamoto").digest()
+    assert sr._rfc6979_k(1, h1) == int(
+        "8F8A276C19F4149656B280621E358CCE"
+        "24F5F52542772691EE69063B74F15D15", 16)
+    d = (1).to_bytes(32, "big")
+    r, s = sr._der_decode(sr.sign(d, b"Satoshi Nakamoto"))
+    assert r == int("934b1ea10a4b3c1757e2b0c017d0b614"
+                    "3ce3c9a7e6a4a49860d7a6ab210ee3d8", 16)
+    low_s = int("2442ce9d2b916064108014783e923ec3"
+                "6b49743e2ffa1c4496f01a512aafd9e5", 16)
+    assert s in (low_s, sr.N - low_s)  # published vector is low-s form
+    # generator point compresses to the known even-y encoding
+    assert sr.pubkey_of(d).hex() == (
+        "0279be667ef9dcbbac55a06295ce870b"
+        "07029bfcdb2dce28d959f2815b16f81798")
+
+
+def test_secp256k1_ref_sign_verify_reject():
+    from tendermint_tpu.utils import secp256k1_ref as sr
+    d = b"\x07" * 32
+    pub = sr.pubkey_of(d)
+    sig = sr.sign(d, b"payload")
+    assert sr.verify(pub, b"payload", sig)
+    assert not sr.verify(pub, b"payloaX", sig)
+    assert not sr.verify(pub, b"payload", sig[:-1] + b"\x00")
+    assert not sr.verify(pub, b"payload", b"not-der")
+    other = sr.pubkey_of(b"\x08" * 32)
+    assert not sr.verify(other, b"payload", sig)
+
+
+def test_secret_connection_roundtrip_over_socketpair():
+    """Full handshake + framed traffic with whichever backend is active
+    (on containers without `cryptography` this exercises the fallback)."""
+    import socket
+    import threading
+
+    from tendermint_tpu.p2p.conn.secret import SecretConnection
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.types.keys import PrivKey
+
+    s1, s2 = socket.socketpair()
+    nk1 = NodeKey(PrivKey.generate(b"\x11" * 32))
+    nk2 = NodeKey(PrivKey.generate(b"\x22" * 32))
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(a=SecretConnection.make(s1, nk1)))
+    t.start()
+    b = SecretConnection.make(s2, nk2)
+    t.join(timeout=30)
+    a = out["a"]
+    assert a.remote_pubkey == nk2.pubkey
+    assert b.remote_pubkey == nk1.pubkey
+    msg = b"0123456789" * 300  # spans multiple 1024B frames
+    a.write(msg)
+    got = b""
+    while len(got) < len(msg):
+        got += b.read()
+    assert got == msg
+    a.close()
+    b.close()

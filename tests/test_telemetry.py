@@ -1,0 +1,346 @@
+"""Telemetry subsystem: registry semantics, exposition format, no-op
+mode, tracing, the /metrics HTTP route, and the check_metrics lint."""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tendermint_tpu import telemetry
+from tendermint_tpu.telemetry.registry import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- registry --
+
+def test_counter_basics():
+    r = Registry()
+    c = r.counter("sub_hits_total", "hits")
+    c.inc()
+    c.inc(2.5)
+    assert r.value("sub_hits_total") == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_counter_labels_independent():
+    r = Registry()
+    c = r.counter("sub_ops_total", "ops", ("kind",))
+    c.labels("a").inc()
+    c.labels(kind="b").inc(4)
+    c.labels("a").inc()
+    assert r.value("sub_ops_total", {"kind": "a"}) == 2
+    assert r.value("sub_ops_total", {"kind": "b"}) == 4
+    assert r.value("sub_ops_total", {"kind": "never"}) is None
+    # a labelled family rejects implicit-child ops and wrong labels
+    with pytest.raises(ValueError):
+        c.inc()
+    with pytest.raises(ValueError):
+        c.labels("a", "b")
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+
+
+def test_gauge_set_inc_dec():
+    r = Registry()
+    g = r.gauge("sub_depth", "depth")
+    g.set(10)
+    g.inc(5)
+    g.dec(2)
+    assert r.value("sub_depth") == 13
+
+
+def test_histogram_bucket_semantics():
+    r = Registry()
+    h = r.histogram("sub_len", "lengths", buckets=(1, 2, 4, 8))
+    for v in (0.5, 1, 2, 3, 8, 9):
+        h.observe(v)
+    out = r.value("sub_len")
+    assert out["count"] == 6
+    assert out["sum"] == 23.5
+    # le buckets are INCLUSIVE upper bounds, cumulative
+    assert out["buckets"][1.0] == 2      # 0.5, 1
+    assert out["buckets"][2.0] == 3      # + 2
+    assert out["buckets"][4.0] == 4      # + 3
+    assert out["buckets"][8.0] == 5      # + 8
+    assert out["buckets"][float("inf")] == 6  # + 9
+
+
+def test_duplicate_registration():
+    r = Registry()
+    a = r.counter("sub_x_total", "x")
+    assert r.counter("sub_x_total", "x") is a       # idempotent
+    with pytest.raises(ValueError):
+        r.gauge("sub_x_total", "x")                 # kind mismatch
+    with pytest.raises(ValueError):
+        r.counter("sub_x_total", "x", ("l",))       # label mismatch
+    r.histogram("sub_h", "h", buckets=(1, 2))
+    with pytest.raises(ValueError):
+        r.histogram("sub_h", "h", buckets=(1, 2, 3))  # bucket mismatch
+
+
+def test_name_validation():
+    r = Registry()
+    for bad in ("", "1x", "Has-Dash", "UPPER", "sp ace"):
+        with pytest.raises(ValueError):
+            r.counter(bad, "bad")
+    with pytest.raises(ValueError):
+        r.counter("sub_ok_total", "x", ("0bad",))
+
+
+def test_noop_mode_records_nothing():
+    r = Registry()
+    c = r.counter("sub_n_total", "n")
+    h = r.histogram("sub_nh", "nh", buckets=(1,))
+    lc = r.counter("sub_nl_total", "nl", ("k",))
+    c.inc()
+    telemetry.set_enabled(False)
+    try:
+        c.inc(100)
+        h.observe(5)
+        lc.labels("a").inc()          # returns the shared no-op child
+        assert not telemetry.enabled()
+    finally:
+        telemetry.set_enabled(True)
+    assert r.value("sub_n_total") == 1
+    assert r.value("sub_nh")["count"] == 0
+    assert r.value("sub_nl_total", {"k": "a"}) is None
+
+
+def test_reset_zeroes_but_keeps_families():
+    r = Registry()
+    c = r.counter("sub_r_total", "r", ("k",))
+    c.labels("a").inc(7)
+    r.reset()
+    assert r.value("sub_r_total", {"k": "a"}) == 0
+    assert "sub_r_total" in r.names()
+
+
+# ----------------------------------------------------------- exposition --
+
+def test_exposition_golden():
+    r = Registry()
+    r.counter("app_reqs_total", "Requests served", ("code",))\
+        .labels(code="200").inc(3)
+    r.gauge("app_depth", "Queue depth").set(2.5)
+    h = r.histogram("app_lat_seconds", "Latency", buckets=(0.1, 1))
+    h.observe(0.05)
+    h.observe(0.5)
+    assert r.expose(namespace="ns") == (
+        "# HELP ns_app_depth Queue depth\n"
+        "# TYPE ns_app_depth gauge\n"
+        "ns_app_depth 2.5\n"
+        "# HELP ns_app_lat_seconds Latency\n"
+        "# TYPE ns_app_lat_seconds histogram\n"
+        'ns_app_lat_seconds_bucket{le="0.1"} 1\n'
+        'ns_app_lat_seconds_bucket{le="1"} 2\n'
+        'ns_app_lat_seconds_bucket{le="+Inf"} 2\n'
+        "ns_app_lat_seconds_sum 0.55\n"
+        "ns_app_lat_seconds_count 2\n"
+        "# HELP ns_app_reqs_total Requests served\n"
+        "# TYPE ns_app_reqs_total counter\n"
+        'ns_app_reqs_total{code="200"} 3\n')
+
+
+def test_exposition_escaping():
+    r = Registry()
+    r.counter("sub_esc_total", 'help with \\ and\nnewline', ("v",))\
+        .labels(v='quo"te\\back\nline').inc()
+    text = r.expose(namespace="t")
+    assert r'# HELP t_sub_esc_total help with \\ and\nnewline' in text
+    assert 't_sub_esc_total{v="quo\\"te\\\\back\\nline"} 1' in text
+
+
+def test_labelless_family_exposes_header_and_zero():
+    r = Registry()
+    r.counter("sub_zero_total", "never incremented")
+    text = r.expose(namespace="tm")
+    assert "# TYPE tm_sub_zero_total counter" in text
+    assert "tm_sub_zero_total 0" in text
+
+
+# ------------------------------------------------------------- tracing --
+
+def test_tracer_span_and_instant():
+    from tendermint_tpu.telemetry.trace import Tracer
+    t = Tracer()
+    with t.span("work", height=3):
+        pass
+    t.instant("mark", round=1)
+    t.complete("step", 0.5, 0.75, step="PROPOSE")
+    evs = t.events()
+    assert [e["ph"] for e in evs] == ["X", "i", "X"]
+    assert evs[0]["name"] == "work" and evs[0]["args"] == {"height": 3}
+    assert evs[0]["dur"] >= 0
+    assert evs[2]["dur"] == pytest.approx(0.25e6)
+    ct = t.chrome_trace()
+    assert ct["traceEvents"] == evs
+
+
+def test_tracer_dump_and_ring(tmp_path):
+    from tendermint_tpu.telemetry.trace import Tracer
+    t = Tracer(capacity=4)
+    for i in range(10):
+        t.instant(f"e{i}")
+    assert len(t.events()) == 4  # ring evicts oldest
+    assert t.events()[0]["name"] == "e6"
+    p = t.dump(str(tmp_path / "trace.json"))
+    with open(p) as f:
+        obj = json.load(f)
+    assert len(obj["traceEvents"]) == 4
+    assert obj["displayTimeUnit"] == "ms"
+
+
+def test_tracer_disabled_is_noop():
+    from tendermint_tpu.telemetry.trace import Tracer
+    t = Tracer()
+    telemetry.set_enabled(False)
+    try:
+        with t.span("x"):
+            pass
+        t.instant("y")
+    finally:
+        telemetry.set_enabled(True)
+    assert t.events() == []
+
+
+# ------------------------------------------------- instrumented modules --
+
+def _small_commit():
+    from tendermint_tpu.types import (PrivKey, Validator, ValidatorSet)
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import Vote, VoteType
+    from tendermint_tpu.types.vote_set import VoteSet
+    from tendermint_tpu.models.verifier import BatchVerifier
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pubkey.ed25519, 10) for p in privs])
+    by_addr = {p.pubkey.address: p for p in privs}
+    bid = BlockID(b"b" * 32, PartSetHeader(1, b"p" * 32))
+    pyv = BatchVerifier("python")
+    vset = VoteSet("telemetry-chain", 1, 0, VoteType.PRECOMMIT, vs,
+                   verifier=pyv)
+    for i, val in enumerate(vs.validators):
+        v = Vote(val.address, i, 1, 0, 1000, VoteType.PRECOMMIT, bid)
+        v.signature = by_addr[val.address].sign(
+            v.sign_bytes("telemetry-chain"))
+        vset.add_vote(v)
+    return vs, bid, vset.make_commit(), pyv
+
+
+def test_verifier_metrics_after_verify_commit():
+    vs, bid, commit, pyv = _small_commit()
+    before = telemetry.value("verifier_sigs_total",
+                             {"backend": "python"}) or 0
+    vs.verify_commit("telemetry-chain", bid, 1, commit, verifier=pyv)
+    after = telemetry.value("verifier_sigs_total", {"backend": "python"})
+    assert after >= before + 4
+    assert telemetry.value("verifier_batch_size")["count"] > 0
+    assert telemetry.value("verifier_dispatch_seconds",
+                           {"backend": "python"})["count"] > 0
+
+
+def test_metrics_route_serves_prometheus_text():
+    """Acceptance shape: /metrics serves valid exposition including the
+    verifier families after a verify_commit, plus the consensus round
+    duration family (registered at import)."""
+    import tendermint_tpu.consensus.state  # noqa: F401 — registers families
+    from tendermint_tpu.rpc.core import RPCEnv, make_server
+    vs, bid, commit, pyv = _small_commit()
+    vs.verify_commit("telemetry-chain", bid, 1, commit, verifier=pyv)
+    server, _core = make_server(RPCEnv())
+    host, port = server.serve("127.0.0.1", 0)
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "# TYPE tm_verifier_batch_size histogram" in body
+        assert "tm_verifier_batch_size_bucket" in body
+        assert "# TYPE tm_consensus_round_duration_seconds histogram" \
+            in body
+        assert 'tm_verifier_calls_total{backend="python"}' in body
+        # every non-comment line is `name{labels} value`
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name_part, _, value = line.rpartition(" ")
+            assert name_part and float(value) is not None
+    finally:
+        server.stop()
+
+
+def test_env_off_makes_call_sites_noop():
+    """TM_TPU_TELEMETRY=off: instrumented paths record nothing, and a
+    config asking for telemetry=True cannot re-enable it."""
+    code = (
+        "from tendermint_tpu import telemetry\n"
+        "assert not telemetry.enabled()\n"
+        "telemetry.configure(enabled=True)  # config must NOT win\n"
+        "assert not telemetry.enabled()\n"
+        "from tendermint_tpu.models.verifier import BatchVerifier\n"
+        "from tendermint_tpu.types.keys import PrivKey\n"
+        "v = BatchVerifier('python')\n"
+        "k = PrivKey.generate(b'\\x01' * 32)\n"
+        "assert v.verify_one(k.pubkey.ed25519, b'm', k.sign(b'm'))\n"
+        "assert telemetry.value('verifier_batch_size')['count'] == 0\n"
+        "assert telemetry.value('verifier_calls_total',\n"
+        "                       {'backend': 'python'}) is None\n"
+        "print('NOOP-OK')\n"
+    )
+    env = dict(os.environ, TM_TPU_TELEMETRY="off", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "NOOP-OK" in out.stdout
+
+
+def test_namespace_configurable():
+    telemetry.configure(namespace="acme")
+    try:
+        assert "acme_verifier_batch_size" in telemetry.expose()
+    finally:
+        telemetry.configure(namespace="tm")
+    with pytest.raises(ValueError):
+        telemetry.configure(namespace="Bad Namespace")
+
+
+def test_consensus_round_metrics_after_committed_heights():
+    """Acceptance: after a small in-process consensus run, the round
+    duration histogram, step counters and height gauge have samples and
+    the trace ring holds the per-step timeline."""
+    import tests.test_consensus as tc
+
+    dur0 = telemetry.value("consensus_round_duration_seconds")["count"]
+    commits0 = telemetry.value("consensus_commits_total") or 0
+    ev0 = len(telemetry.TRACER.events())
+    nodes, _ = tc.make_net(1)
+    nodes[0].start()
+    tc.run_until_height(nodes, 2)
+    dur1 = telemetry.value("consensus_round_duration_seconds")["count"]
+    assert dur1 >= dur0 + 2                      # one per committed round
+    assert telemetry.value("consensus_commits_total") >= commits0 + 2
+    assert telemetry.value("consensus_height") >= 2
+    assert telemetry.value("consensus_steps_total",
+                           {"step": "COMMIT"}) >= 2
+    names = {e["name"] for e in telemetry.TRACER.events()[ev0:]}
+    assert "cs:finalize_commit" in names
+    assert any(n.startswith("cs:") and n != "cs:finalize_commit"
+               for n in names)
+    assert "tm_consensus_round_duration_seconds_sum" in telemetry.expose()
+
+
+# ------------------------------------------------------- check_metrics --
+
+def test_check_metrics_lint_passes():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_metrics.py")],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
