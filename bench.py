@@ -247,6 +247,113 @@ def bench_verifier_json(path: str = "BENCH_verifier.json",
     return doc
 
 
+def bench_coalesce_json(path: str = "BENCH_coalesce.json",
+                        callers=(1, 4, 16, 64), budget_s: float = 1.5,
+                        n_keys: int = 64) -> dict:
+    """Coalescer trajectory point: verifies/sec at N concurrent
+    single-vote callers, dispatch coalescing ON vs OFF (the live-
+    consensus arrival shape — every call is a batch of 1 from its own
+    thread). The coalesce factor and mean merged batch size come FROM
+    THE TELEMETRY INSTRUMENTS (tm_verifier_coalesce_*,
+    tm_verifier_batch_size deltas), so the artifact doubles as a live
+    check of the new catalog."""
+    import threading
+
+    from tendermint_tpu import telemetry
+    from tendermint_tpu.models.verifier import BatchVerifier
+    from tendermint_tpu.utils import ed25519_ref as ref
+    from bench_util import fast_signer
+
+    pubs, msgs, sigs = [], [], []
+    for i in range(n_keys):
+        seed = (i + 1).to_bytes(32, "little")
+        pubs.append(ref.public_key(seed))
+        m = b"bench-coalesce-%d" % i
+        msgs.append(m)
+        sigs.append(fast_signer(seed)(m))
+
+    def run(nc: int, mode: str) -> tuple[float, dict]:
+        env_prev = os.environ.get("TM_TPU_COALESCE")
+        os.environ["TM_TPU_COALESCE"] = mode  # env wins by design
+        try:
+            v = BatchVerifier("auto")
+        finally:
+            if env_prev is None:
+                os.environ.pop("TM_TPU_COALESCE", None)
+            else:
+                os.environ["TM_TPU_COALESCE"] = env_prev
+        # warm: routing, table/caches, coalescer thread
+        for i in range(min(nc, n_keys)):
+            assert bool(v.verify([(pubs[i], msgs[i], sigs[i])])[0])
+        c0 = telemetry.value("verifier_coalesce_calls_total") or 0
+        d0 = telemetry.value("verifier_coalesce_dispatches_total") or 0
+        b0 = telemetry.value("verifier_batch_size")
+        counts = [0] * nc
+        stop = time.perf_counter() + budget_s
+
+        def worker(t: int) -> None:
+            i = t % n_keys
+            item = [(pubs[i], msgs[i], sigs[i])]
+            n_done = 0
+            while time.perf_counter() < stop:
+                assert bool(v.verify(item)[0])
+                n_done += 1
+            counts[t] = n_done
+
+        ths = [threading.Thread(target=worker, args=(t,))
+               for t in range(nc)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        dt = time.perf_counter() - t0
+        c1 = telemetry.value("verifier_coalesce_calls_total") or 0
+        d1 = telemetry.value("verifier_coalesce_dispatches_total") or 0
+        b1 = telemetry.value("verifier_batch_size")
+        tele = {}
+        if mode != "off" and d1 > d0:
+            tele["coalesce_factor"] = round((c1 - c0) / (d1 - d0), 2)
+            tele["mean_coalesced_batch"] = round(
+                (b1["sum"] - b0["sum"]) / (b1["count"] - b0["count"]), 2)
+        v.close()
+        return sum(counts) / dt, tele
+
+    was_enabled = telemetry.enabled()
+    telemetry.set_enabled(True)
+    points = []
+    try:
+        for nc in callers:
+            off_rate, _ = run(nc, "off")
+            on_rate, tele = run(nc, "on")
+            points.append({
+                "callers": nc,
+                "off_verifies_per_sec": round(off_rate, 1),
+                "on_verifies_per_sec": round(on_rate, 1),
+                "speedup": round(on_rate / off_rate, 2) if off_rate else None,
+                **tele,
+            })
+    finally:
+        telemetry.set_enabled(was_enabled)
+    import jax
+    doc = {
+        "metric": "verifier_coalesce_throughput",
+        "unit": "verifies/sec",
+        "backend": jax.devices()[0].platform,
+        "workload": "N threads each looping 1-signature verify() calls "
+                    "(live-consensus vote arrival shape), stable "
+                    f"{n_keys}-key valset",
+        "source": "telemetry (tm_verifier_coalesce_*, "
+                  "tm_verifier_batch_size deltas)",
+        "knobs": {"TM_TPU_COALESCE": "on/off per arm",
+                  "wait_ms": 2.0, "budget_s_per_arm": budget_s},
+        "points": points,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def main() -> int:
     import numpy as np
     import jax
@@ -504,6 +611,11 @@ def main() -> int:
                 e["lite_1m"], "headers", "target_headers",
                 "scaled_to_budget", "headers_per_sec",
                 "median_wave_headers_per_sec", "sig_verifies_per_sec")
+        if "coalesce" in e:
+            s["coalesce"] = [
+                pick(p, "callers", "speedup", "coalesce_factor",
+                     "on_verifies_per_sec")
+                for p in e["coalesce"].get("points", [])]
         if "testnet" in e:
             s["testnet_blocks_per_sec"] = e["testnet"].get(
                 "blocks_per_sec")
@@ -519,7 +631,7 @@ def main() -> int:
             s["fastsync_smallblocks"] = pick(
                 e["fastsync_smallblocks"], "blocks_per_sec", "vs_scalar")
         for k in ("commit100", "lite", "testnet", "fastsync",
-                  "fastsync_smallblocks", "lite_1m"):
+                  "fastsync_smallblocks", "lite_1m", "coalesce"):
             if f"{k}_error" in e:
                 s[f"{k}_error"] = e[f"{k}_error"]
         s["arm_seconds"] = e.get("arm_seconds", {})
@@ -628,6 +740,7 @@ def main() -> int:
         # fastsync (VERDICT r4 next #2) so a budget overrun degrades
         # the giants' scale (scaled_to_budget fields) instead of
         # losing arms to the driver's SIGTERM
+        arm("coalesce", lambda: bench_coalesce_json())
         arm("lite", _lite)
         arm("testnet", _testnet)
         arm("fastsync_smallblocks", _fastsync_small)
@@ -645,6 +758,10 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--coalesce-json" in sys.argv:
+        # standalone quick mode: only the BENCH_coalesce.json satellite
+        print(json.dumps(bench_coalesce_json()), flush=True)
+        sys.exit(0)
     if "--verifier-json" in sys.argv:
         # standalone quick mode: only the BENCH_verifier.json satellite
         _sizes = tuple(int(b) for b in os.environ.get(

@@ -31,6 +31,7 @@ KNOWN_SUBSYSTEMS = {
 
 INSTRUMENTED_MODULES = [
     "tendermint_tpu.models.verifier",
+    "tendermint_tpu.models.coalescer",
     "tendermint_tpu.ops.merkle",
     "tendermint_tpu.consensus.state",
     "tendermint_tpu.mempool.mempool",

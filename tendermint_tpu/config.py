@@ -27,6 +27,14 @@ class BaseConfig:
     # the device mesh (models/verifier.py)
     verifier_backend: str = "auto"
     verifier_mesh: str = "auto"
+    # cross-call dispatch coalescing (models/coalescer.py): merge
+    # concurrent sub-threshold verify calls into one device batch.
+    # auto|on|off; wait_ms is the max linger per merged batch (the
+    # adaptive window never exceeds it); max_batch 0 = BATCH_CHUNK.
+    # Env TM_TPU_COALESCE / _WAIT_MS / _MAX_BATCH win over these.
+    verifier_coalesce: str = "auto"
+    verifier_coalesce_wait_ms: float = 2.0
+    verifier_coalesce_max_batch: int = 0
     # telemetry plane (telemetry/): metrics + tracing on by default; the
     # namespace prefixes every exposed metric (tm_verifier_batch_size).
     # Env TM_TPU_TELEMETRY=off overrides `telemetry` unconditionally.

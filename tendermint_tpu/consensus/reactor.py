@@ -275,8 +275,15 @@ class ConsensusReactor(Reactor):
                         hb.validator_address)
                     if val is None or idx != hb.validator_index:
                         return  # not a current validator: drop
-                    from tendermint_tpu.types.keys import PubKey
-                    if not PubKey(val.pubkey).verify(
+                    # verifier boundary, not scalar PubKey.verify: a
+                    # coalescing verifier batches heartbeats with the
+                    # concurrent vote/proposal verify traffic
+                    from tendermint_tpu.models.verifier import \
+                        default_verifier
+                    verifier = self.cs.block_exec.verifier or \
+                        default_verifier()
+                    if not verifier.verify_one(
+                            val.pubkey,
                             hb.sign_bytes(self.cs.state.chain_id),
                             hb.signature):
                         return  # forged: drop

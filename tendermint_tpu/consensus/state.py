@@ -714,9 +714,15 @@ class ConsensusState:
                 (0 <= proposal.pol_round < proposal.round):
             raise ValueError("invalid proposal POL round")
         proposer = rs.validators.proposer()
-        from tendermint_tpu.types.keys import PubKey
-        if not PubKey(proposer.pubkey).verify(
-                proposal.sign_bytes(self.state.chain_id), proposal.signature):
+        # through the BatchVerifier boundary (not scalar PubKey.verify):
+        # a coalescing verifier merges this with the vote traffic of
+        # concurrent peers/nodes into one device batch, and a mesh/jax
+        # verifier keeps ALL signature policy in one place
+        from tendermint_tpu.models.verifier import default_verifier
+        verifier = self.block_exec.verifier or default_verifier()
+        if not verifier.verify_one(
+                proposer.pubkey, proposal.sign_bytes(self.state.chain_id),
+                proposal.signature):
             raise ValueError("invalid proposal signature")
         rs.proposal = proposal
         if rs.proposal_block_parts is None or \

@@ -112,6 +112,20 @@ def _mesh_kernel(n_devices: int) -> Callable:
         return _mesh_kernels[n_devices]
 
 
+def _parse_coalesce_spec(spec: str) -> str:
+    """'auto' | 'on' | 'off'. Same eager-validation contract as
+    _parse_mesh_spec: config/env typos must fail at construction."""
+    s = str(spec).strip().lower()
+    if s in ("auto", ""):
+        return "auto"
+    if s in ("on", "1", "true", "yes"):
+        return "on"
+    if s in ("off", "0", "false", "no", "none"):
+        return "off"
+    raise ValueError(
+        f"verifier coalesce must be auto|on|off, got {spec!r}")
+
+
 def _parse_mesh_spec(mesh: str) -> str | int:
     """'auto' | 'off' | power-of-two int. Raises ValueError on anything
     else — callers (Node.__init__) validate the config knob eagerly so a
@@ -136,7 +150,9 @@ def _parse_mesh_spec(mesh: str) -> str | int:
 class BatchVerifier:
     def __init__(self, backend: str = "auto", auto_threshold: int = None,
                  kernel: Callable | None = None, mesh: str = "off",
-                 min_bucket: int = 8):
+                 min_bucket: int = 8, coalesce: str | None = None,
+                 coalesce_wait_ms: float | None = None,
+                 coalesce_max_batch: int | None = None):
         # auto_threshold: batches at or below this verify scalar on host
         # (OpenSSL, ~130us/sig). The scalar/batch breakeven depends on
         # the dispatch round trip: ~30-50 sigs on a locally-attached
@@ -164,7 +180,28 @@ class BatchVerifier:
         self._min_bucket = min_bucket
         self._mesh_resolved = kernel is not None or self.mesh == "off"
         self._resolve_lock = threading.Lock()
-        self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0}
+        # stats mutations are read-modify-writes reached from every
+        # reactor/RPC thread concurrently — one lock, held for dict
+        # arithmetic only (never across a dispatch)
+        self._stats_lock = threading.Lock()
+        self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0,
+                      "coalesced_calls": 0}
+        # cross-call dispatch coalescing (models/coalescer.py): merge
+        # concurrent sub-threshold verify calls into one batch. Env
+        # knobs win over constructor args (same contract as telemetry:
+        # an operator's TM_TPU_COALESCE=off must silence any config).
+        env = os.environ.get("TM_TPU_COALESCE")
+        self.coalesce = _parse_coalesce_spec(
+            env if env else ("auto" if coalesce is None else coalesce))
+        if coalesce_wait_ms is None:
+            coalesce_wait_ms = float(os.environ.get(
+                "TM_TPU_COALESCE_WAIT_MS", "2.0"))
+        self._coalesce_wait_s = coalesce_wait_ms / 1e3
+        if coalesce_max_batch is None:
+            coalesce_max_batch = int(os.environ.get(
+                "TM_TPU_COALESCE_MAX_BATCH", "0"))
+        self._coalesce_max_batch = coalesce_max_batch or BATCH_CHUNK
+        self._coalescer = None  # built on first qualifying submit
 
     def _resolve_mesh(self) -> None:
         """Build the sharded kernel on first device dispatch. mesh='auto'
@@ -209,10 +246,47 @@ class BatchVerifier:
         caller can overlap device compute with host work (the pipelined
         fast-sync loop applies window k-1 while window k verifies
         on-device); every chunk is enqueued up front so the tunnel
-        round-trip is paid once."""
+        round-trip is paid once.
+
+        Sub-threshold calls route through the dispatch coalescer
+        (models/coalescer.py) unless coalesce='off': concurrent
+        single-vote callers merge into one batched dispatch, each
+        getting back exactly its own verdicts. Calls already above the
+        threshold are efficient as-is and dispatch directly."""
         n = len(items)
-        self.stats["calls"] += 1
-        self.stats["sigs"] += n
+        if self.coalesce != "off" and 0 < n <= self.auto_threshold:
+            with self._stats_lock:
+                self.stats["coalesced_calls"] += 1
+            c = self._coalescer
+            if c is None:
+                with self._resolve_lock:
+                    if self._coalescer is None:
+                        from tendermint_tpu.models.coalescer import \
+                            DispatchCoalescer
+                        self._coalescer = DispatchCoalescer(
+                            self._verify_async_direct,
+                            max_batch=self._coalesce_max_batch,
+                            max_wait_s=self._coalesce_wait_s)
+                    c = self._coalescer
+            return c.submit(items)
+        return self._verify_async_direct(items)
+
+    def close(self) -> None:
+        """Stop the coalescer dispatcher, if one was started. Safe to
+        call repeatedly; the verifier remains usable (a later coalesced
+        call starts a fresh dispatcher)."""
+        with self._resolve_lock:
+            c, self._coalescer = self._coalescer, None
+        if c is not None:
+            c.close()
+
+    def _verify_async_direct(self, items):
+        """The non-coalescing dispatch path (also the coalescer's merge
+        target — it must never re-enter verify_async)."""
+        n = len(items)
+        with self._stats_lock:
+            self.stats["calls"] += 1
+            self.stats["sigs"] += n
         if n == 0:
             out0 = np.zeros(0, np.bool_)
             return lambda: out0
@@ -221,10 +295,11 @@ class BatchVerifier:
         use_jax = self.backend == "jax" or (
             self.backend == "auto" and n > self.auto_threshold)
         if not use_jax:
-            # scalar host path, routed by key type (ed25519 | secp256k1)
-            from tendermint_tpu.types.keys import verify_any
-            out1 = np.array([verify_any(p, m, s) for p, m, s in items],
-                            np.bool_)
+            # scalar host path, routed by key type (ed25519 |
+            # secp256k1); batches big enough to amortize per-key
+            # precompute use the table oracle (keys.verify_many)
+            from tendermint_tpu.types.keys import verify_many
+            out1 = np.array(verify_many(items), np.bool_)
             if telemetry.enabled():
                 _m_calls.labels("python").inc()
                 _m_sigs.labels("python").inc(n)
@@ -241,16 +316,19 @@ class BatchVerifier:
             from tendermint_tpu.ops import ed25519
             if not self._mesh_resolved:
                 self._resolve_mesh()
-            self.stats["jax_sigs"] += n
             self._record_jax_dispatch(n)
             pk, rb, sb, hb, pre = prep
             pending = []
+            occ = telemetry.enabled()
             for lo in range(0, n, BATCH_CHUNK):
                 hi = min(lo + BATCH_CHUNK, n)
                 res = ed25519.verify_prepared_async(
                     pk[lo:hi], rb[lo:hi], sb[lo:hi], hb[lo:hi],
                     kernel=self.kernel, min_bucket=self._min_bucket)
                 pending.append((lo, hi, res, pre[lo:hi]))
+                if occ:
+                    _m_occupancy.observe((hi - lo) / ed25519._bucket(
+                        hi - lo, min_size=self._min_bucket))
             return self._make_resolver(n, pending, t_dispatch=t_dispatch)
         # mixed-key routing: 33-byte compressed-SEC1 pubkeys are
         # secp256k1 — verified on host (off the TPU hot path by design,
@@ -268,9 +346,10 @@ class BatchVerifier:
                 for i, ok in secp_ok.items():
                     out2[i] = ok
                 return lambda: out2
-            inner = self.verify_async(ed_items)
-            self.stats["calls"] -= 1  # the outer call already counted
-            self.stats["sigs"] -= len(ed_items)
+            inner = self._verify_async_direct(ed_items)
+            with self._stats_lock:
+                self.stats["calls"] -= 1  # the outer call already counted
+                self.stats["sigs"] -= len(ed_items)
 
             def resolve_mixed() -> np.ndarray:
                 ed_ok = inner()
@@ -288,34 +367,33 @@ class BatchVerifier:
         from tendermint_tpu.ops import ed25519
         if not self._mesh_resolved:
             self._resolve_mesh()
-        self.stats["jax_sigs"] += n
         self._record_jax_dispatch(n)
         pubkeys = [it[0] for it in items]
         msgs = [it[1] for it in items]
         sigs = [it[2] for it in items]
         pending = []
+        occ = telemetry.enabled()
         for lo in range(0, n, BATCH_CHUNK):
             hi = min(lo + BATCH_CHUNK, n)
             res, pre = ed25519.verify_batch_async(
                 pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], kernel=self.kernel,
                 min_bucket=self._min_bucket)
             pending.append((lo, hi, res, pre))
+            if occ:
+                _m_occupancy.observe((hi - lo) / ed25519._bucket(
+                    hi - lo, min_size=self._min_bucket))
         return self._make_resolver(n, pending, t_dispatch=t_dispatch)
 
     def _record_jax_dispatch(self, n: int) -> None:
-        """Batch/backend/occupancy samples for one device dispatch. The
-        occupancy a chunk actually runs at is its size over the padded
-        power-of-two bucket ed25519._bucket routes it to — low values
-        mean the device is hashing padding."""
+        """Stats + calls/sigs samples for one device dispatch (chunk
+        occupancy is observed inside the chunk loops, where lo/hi and
+        the ed25519 module are already in hand)."""
+        with self._stats_lock:
+            self.stats["jax_sigs"] += n
         if not telemetry.enabled():
             return
-        from tendermint_tpu.ops import ed25519
         _m_calls.labels("jax").inc()
         _m_sigs.labels("jax").inc(n)
-        for lo in range(0, n, BATCH_CHUNK):
-            c = min(lo + BATCH_CHUNK, n) - lo
-            _m_occupancy.observe(
-                c / ed25519._bucket(c, min_size=self._min_bucket))
 
     @staticmethod
     def _make_resolver(n: int, pending, t_dispatch: float = 0.0):

@@ -82,10 +82,21 @@ class Node:
                                                     default_verifier)
         vb = getattr(config.base, "verifier_backend", "auto")
         vm = str(getattr(config.base, "verifier_mesh", "auto"))
-        if (vb, vm) == ("auto", "auto"):
+        vc = str(getattr(config.base, "verifier_coalesce", "auto"))
+        vc_wait = float(getattr(config.base,
+                                "verifier_coalesce_wait_ms", 2.0))
+        vc_max = int(getattr(config.base,
+                             "verifier_coalesce_max_batch", 0))
+        if (vb, vm, vc, vc_wait, vc_max) == \
+                ("auto", "auto", "auto", 2.0, 0):
+            # all-default: share the process-wide verifier — in-process
+            # testnets then coalesce vote verification ACROSS nodes,
+            # exactly the aggregate-arrival-rate win the coalescer is for
             self.verifier = default_verifier()
         else:
-            self.verifier = BatchVerifier(vb, mesh=vm)
+            self.verifier = BatchVerifier(
+                vb, mesh=vm, coalesce=vc, coalesce_wait_ms=vc_wait,
+                coalesce_max_batch=vc_max or None)
 
         # ABCI handshake: sync app with stores (consensus/replay.go:211)
         handshaker = Handshaker(self.state_store, self.block_store, gen_doc,
@@ -288,6 +299,11 @@ class Node:
         self.app_conns.close()
         if hasattr(self.wal, "close"):
             self.wal.close()
+        # only a verifier this node OWNS: the shared default verifier's
+        # coalescer keeps serving the process's other nodes
+        from tendermint_tpu.models import verifier as _verifier_mod
+        if self.verifier is not _verifier_mod._default:
+            self.verifier.close()
 
     @property
     def height(self) -> int:

@@ -189,17 +189,30 @@ def verify_from_bytes_best(pk, rb, s_bytes, h_bytes):
 # Point decompression is a field sqrt — a ~250-multiply exponentiation,
 # a significant slice of the verify kernel — yet consensus workloads
 # verify the SAME validator set's keys over and over (every commit,
-# every fast-sync window, every lite header). The cache keys on the
-# content hash of the padded pubkey batch: from its second occurrence
-# on, batches skip decompression entirely via the *_pre kernels.
+# every fast-sync window, every lite header). The cache keys PER
+# 32-BYTE PUBKEY (it used to key on the content hash of the whole
+# padded batch, which coalesced mixed-validator batches — arbitrary
+# vote compositions merged by models/coalescer.py — would never hit):
+# once a validator's key has been decompressed once, EVERY later batch
+# containing it hits, regardless of batch composition or order. Rows
+# are the canonical field bytes of (-A).x / A.y plus the validity flag
+# (65 bytes each) — host-resident, re-assembled and re-uploaded per
+# batch (m x 64B, trivial next to the sqrt the *_pre kernels skip).
 
-_PREDECOMP_MAX = 8
+_PREDECOMP_MAX_KEYS = 16384  # rows, ~1MB — covers a 10k-validator set
 # batches below this padded size skip the cache: one-shot small batches
 # must not pay the extra decompress dispatch (tests lower it to drive
 # the cache logic on already-compiled small shapes)
 _PREDECOMP_MIN_BATCH = 64
+# pubkey -> (xneg_bytes u8[32], y_bytes u8[32], ok bool)
 _predecomp: "OrderedDict[bytes, tuple]" = OrderedDict()
+# pubkeys sighted once (first sighting stays on the fused full kernel:
+# a one-shot batch must not pay a separate decompress dispatch)
 _predecomp_seen: "OrderedDict[bytes, bool]" = OrderedDict()
+# hit   = batch fully served from cached rows (pre kernel, no sqrt)
+# fill  = repeat-traffic batch decompressed once + rows stored
+# full  = mostly-unseen batch routed to the fused full kernel
+_predecomp_stats = {"hit": 0, "fill": 0, "full": 0}
 # Batched verifies dispatch concurrently (fast-sync collector, lite
 # certify, RPC handlers all share default_verifier()), and OrderedDict
 # mutation is not thread-safe: a racing popitem against move_to_end can
@@ -241,35 +254,58 @@ def _verify_pre_pallas(xnb, yb, ok, rb, s_bytes, h_bytes):
 
 def _verify_cached_predecomp(pk_np, rb, s_bytes, h_bytes):
     """Returns verdicts via the predecompressed path, or None when this
-    pubkey batch hasn't repeated yet (one-shot batches must not pay the
-    extra decompress dispatch)."""
-    key = hashlib.sha256(pk_np.tobytes()).digest()
-    with _predecomp_lock:
-        ent = _predecomp.get(key)
-        if ent is None and key not in _predecomp_seen:
-            # first sighting: remember it, use the fused full kernel
-            _predecomp_seen[key] = True
-            while len(_predecomp_seen) > 4 * _PREDECOMP_MAX:
-                _predecomp_seen.popitem(last=False)
-            return None
-        if ent is not None:
-            _predecomp.move_to_end(key)
-    if ent is None:
-        # decompress outside the lock (device dispatch); a concurrent
-        # duplicate fill is harmless — last writer wins, same content
-        xnb, yb, ok = _decompress_to_bytes(jnp.asarray(pk_np))
-        ent = (xnb, yb, ok)
-        with _predecomp_lock:
-            _predecomp[key] = ent
-            while len(_predecomp) > _PREDECOMP_MAX:
-                _predecomp.popitem(last=False)
-    xnb, yb, ok = ent
+    batch's pubkeys are mostly fresh (a first-sighting batch must not
+    pay the extra decompress dispatch — it takes the fused full kernel
+    while its keys are marked seen; any later batch made of seen keys
+    decompresses ONCE and fills per-key rows)."""
     n = pk_np.shape[0]
+    raw = pk_np.tobytes()
+    keys = [raw[i * 32:(i + 1) * 32] for i in range(n)]
+    with _predecomp_lock:
+        rows = [_predecomp.get(k) for k in keys]
+        miss = {k for k, r in zip(keys, rows) if r is None}
+        if not miss:
+            for k in keys:
+                _predecomp.move_to_end(k)
+            _predecomp_stats["hit"] += 1
+        else:
+            fresh = miss - _predecomp_seen.keys()
+            for k in fresh:
+                _predecomp_seen[k] = True
+            while len(_predecomp_seen) > 4 * _PREDECOMP_MAX_KEYS:
+                _predecomp_seen.popitem(last=False)
+            if fresh:
+                # unseen keys in the batch: fused full kernel (no extra
+                # dispatch); the NEXT batch over these keys fills rows
+                _predecomp_stats["full"] += 1
+                return None
+            _predecomp_stats["fill"] += 1
+    if miss:
+        # repeat traffic over uncached keys: decompress the whole batch
+        # once (outside the lock — device dispatch), store per-key rows.
+        # A concurrent duplicate fill is harmless: same key, same bytes.
+        xnb_d, yb_d, ok_d = _decompress_to_bytes(jnp.asarray(pk_np))
+        xnb_h = np.asarray(xnb_d)
+        yb_h = np.asarray(yb_d)
+        ok_h = np.asarray(ok_d)
+        with _predecomp_lock:
+            for i, k in enumerate(keys):
+                if k not in _predecomp:
+                    _predecomp[k] = (xnb_h[i].copy(), yb_h[i].copy(),
+                                     bool(ok_h[i]))
+            while len(_predecomp) > _PREDECOMP_MAX_KEYS:
+                _predecomp.popitem(last=False)
+    else:
+        xnb_h = np.stack([r[0] for r in rows])
+        yb_h = np.stack([r[1] for r in rows])
+        ok_h = np.array([r[2] for r in rows], np.bool_)
     if _pallas_available() and n >= 512 and n % 512 == 0:
-        return _verify_pre_pallas(xnb, yb, ok, jnp.asarray(rb),
+        return _verify_pre_pallas(jnp.asarray(xnb_h), jnp.asarray(yb_h),
+                                  jnp.asarray(ok_h), jnp.asarray(rb),
                                   jnp.asarray(s_bytes),
                                   jnp.asarray(h_bytes))
-    return _verify_pre_jnp(xnb, yb, ok, jnp.asarray(rb),
+    return _verify_pre_jnp(jnp.asarray(xnb_h), jnp.asarray(yb_h),
+                           jnp.asarray(ok_h), jnp.asarray(rb),
                            jnp.asarray(s_bytes), jnp.asarray(h_bytes))
 
 

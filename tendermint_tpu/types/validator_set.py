@@ -276,6 +276,26 @@ class ValidatorSet:
             raise ValueError(
                 f"insufficient voting power: {power_for_block}/{self.total_voting_power()}")
 
+    def verify_commit_async(self, chain_id: str, block_id, height: int,
+                            commit, verifier=None):
+        """Dispatch phase of verify_commit WITHOUT blocking: structural
+        checks + signature dispatch run now (raising ValueError on
+        structural failure immediately), and the returned zero-arg
+        finisher completes the power check — raising exactly what
+        verify_commit would. Opt-in async path: lets fast-sync/replay
+        overlap device crypto with host work and lets a coalescing
+        verifier merge concurrent commit verifies into one batch."""
+        from tendermint_tpu.models.verifier import default_verifier
+        verifier = verifier or default_verifier()
+        items, item_power = self.commit_verification_items(
+            chain_id, block_id, height, commit)
+        resolve_ok = verifier.verify_async(items)
+
+        def finish() -> None:
+            self.check_commit_results(resolve_ok(), item_power)
+
+        return finish
+
     def verify_commit(self, chain_id: str, block_id, height: int, commit,
                       verifier=None) -> None:
         """Verify that +2/3 of this set signed the commit.
@@ -285,12 +305,8 @@ class ValidatorSet:
         power counting — but the signatures are verified as ONE batch.
         Raises ValueError on failure.
         """
-        from tendermint_tpu.models.verifier import default_verifier
-        verifier = verifier or default_verifier()
-        items, item_power = self.commit_verification_items(
-            chain_id, block_id, height, commit)
-        ok = verifier.verify(items)
-        self.check_commit_results(ok, item_power)
+        self.verify_commit_async(chain_id, block_id, height, commit,
+                                 verifier=verifier)()
 
     def verify_commit_any(self, new_set: "ValidatorSet", chain_id: str,
                           block_id, height: int, commit, verifier=None) -> None:

@@ -65,6 +65,19 @@ class VoteSet:
         step checks, duplicate check, THEN signature."""
         return self._add_votes([vote])[0]
 
+    def add_vote_async(self, vote: Vote):
+        """Opt-in async add: dispatches the signature verification
+        WITHOUT blocking (through BatchVerifier.verify_async, so a
+        coalescing verifier merges it with concurrent peers' votes into
+        one device batch) and returns a zero-arg resolver that applies
+        the vote and returns add_vote's result — raising exactly what
+        add_vote would. Only the crypto is offloaded: validation runs
+        now, the VoteSet mutation runs inside the resolver, which must
+        execute on the thread that owns this VoteSet (consensus lock
+        held)."""
+        finish = self._add_votes_async([vote])
+        return lambda: finish()[0]
+
     def add_votes_batch(self, votes: List[Vote]
                         ) -> tuple[List[bool], List[tuple[int, Exception]]]:
         """Batch ingestion (replay, catch-up, gossip bursts): one
@@ -83,6 +96,14 @@ class VoteSet:
     def _add_votes(self, votes: List[Vote],
                    errors: Optional[List[tuple[int, Exception]]] = None
                    ) -> List[bool]:
+        return self._add_votes_async(votes, errors)()
+
+    def _add_votes_async(self, votes: List[Vote],
+                         errors: Optional[List[tuple[int, Exception]]] = None):
+        """Validation now, signature dispatch now (async), application
+        in the returned zero-arg finisher — the split that lets callers
+        overlap device crypto with host work and lets the coalescer
+        merge concurrent dispatches."""
         from tendermint_tpu.models.verifier import default_verifier
         verifier = self.verifier or default_verifier()
 
@@ -128,23 +149,29 @@ class VoteSet:
             # (on conflict: still verify the signature before accusing)
             to_verify.append((vote, val, pos))
 
-        ok = verifier.verify([
+        resolve_ok = verifier.verify_async([
             (val.pubkey, v.sign_bytes(self.chain_id), v.signature)
             for v, val, _ in to_verify])
-        for valid, (vote, val, pos) in zip(ok, to_verify):
-            if not valid:
-                fail(pos, ValueError(f"invalid signature on {vote}"))
-                continue
-            try:
-                results[pos] = self._add_verified(vote, val)
-            except ConflictingVoteError as e:
-                # e.added: the vote WAS counted (peer-claimed maj23
-                # block) — the result must say applied even though the
-                # conflict is also reported, or a batch caller skips
-                # the quorum transitions the vote may have triggered
-                results[pos] = e.added
-                fail(pos, e)
-        return results
+
+        def finish() -> List[bool]:
+            ok = resolve_ok()
+            for valid, (vote, val, pos) in zip(ok, to_verify):
+                if not valid:
+                    fail(pos, ValueError(f"invalid signature on {vote}"))
+                    continue
+                try:
+                    results[pos] = self._add_verified(vote, val)
+                except ConflictingVoteError as e:
+                    # e.added: the vote WAS counted (peer-claimed maj23
+                    # block) — the result must say applied even though
+                    # the conflict is also reported, or a batch caller
+                    # skips the quorum transitions the vote may have
+                    # triggered
+                    results[pos] = e.added
+                    fail(pos, e)
+            return results
+
+        return finish
 
     def _add_verified(self, vote: Vote, val) -> bool:
         """types/vote_set.go:219-287 addVerifiedVote, exactly:

@@ -55,7 +55,10 @@ def _no_leaked_tm_threads():
     log into torn-down streams — the round-2 'Logging error' class.
 
     Only tm-* names opt in; the process-wide verify fetch pool
-    (tm-verify-fetch) is deliberately long-lived and excluded."""
+    (tm-verify-fetch) and the verifier coalescer dispatcher
+    (tm-verify-coalesce — shared by the default verifier, daemon,
+    idle-parked and self-reaping after 30s) are deliberately
+    long-lived and excluded."""
     before = {t.ident for t in threading.enumerate()}
     # a longer-scoped fixture (module-scoped node) legitimately keeps
     # respawning its threads (each ticker schedule is a fresh Timer
@@ -67,7 +70,8 @@ def _no_leaked_tm_threads():
                 if t.ident not in before and t.is_alive()
                 and t.name.startswith("tm-")
                 and t.name not in before_names
-                and not t.name.startswith("tm-verify-fetch")]
+                and not t.name.startswith("tm-verify-fetch")
+                and not t.name.startswith("tm-verify-coalesce")]
 
     yield
     deadline = time.monotonic() + 3.0

@@ -231,14 +231,17 @@ def test_heartbeat_replay_deduped_and_stale_dropped():
         "tendermint_tpu.consensus.reactor",
         fromlist=["PeerRoundState"]).PeerRoundState()
 
+    # heartbeats verify through the BatchVerifier boundary (so a
+    # coalescing verifier can merge them with vote traffic) — count
+    # there, not at the scalar PubKey.verify the reactor no longer uses
     verifies = 0
-    import tendermint_tpu.types.keys as keys_mod
-    orig_verify = keys_mod.PubKey.verify
+    from tendermint_tpu.models.verifier import BatchVerifier
+    orig_verify = BatchVerifier.verify_one
     def counting_verify(self, *a, **k):
         nonlocal verifies
         verifies += 1
         return orig_verify(self, *a, **k)
-    keys_mod.PubKey.verify = counting_verify
+    BatchVerifier.verify_one = counting_verify
     try:
         idx, _ = cs.rs.validators.get_by_address(keys[1].pubkey.address)
         hb = Heartbeat(keys[1].pubkey.address, idx, cs.rs.height, 0, 3)
@@ -258,7 +261,7 @@ def test_heartbeat_replay_deduped_and_stale_dropped():
             {"type": "heartbeat", "heartbeat": stale.to_obj()}))
         assert verifies == 1 and not drain()
     finally:
-        keys_mod.PubKey.verify = orig_verify
+        BatchVerifier.verify_one = orig_verify
 
 
 def test_commit_cache_invalidates_on_mutation():

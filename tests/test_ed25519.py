@@ -146,9 +146,21 @@ def test_predecompressed_cache_path_matches_full():
         assert r1.tolist() == expect
         r2 = ed25519.verify_batch(pubs, msgs, sigs)  # builds + uses cache
         assert r2.tolist() == expect
-        assert len(ed25519._predecomp) == 1, "cache did not engage"
+        # per-pubkey rows: one per distinct key (incl. the invalid one,
+        # cached with ok=False so forged keys never re-pay the sqrt)
+        assert len(ed25519._predecomp) == n, "cache did not engage"
         r3 = ed25519.verify_batch(pubs, msgs, sigs)  # cache hit
         assert r3.tolist() == expect
+        assert ed25519._predecomp_stats["hit"] >= 1
+        # the point of per-KEY rows: a REORDERED batch over the same
+        # keys is still a pure cache hit (batch-content keying missed)
+        hits0 = ed25519._predecomp_stats["hit"]
+        perm = list(range(n))[::-1]
+        r4 = ed25519.verify_batch([pubs[i] for i in perm],
+                                  [msgs[i] for i in perm],
+                                  [sigs[i] for i in perm])
+        assert r4.tolist() == [expect[i] for i in perm]
+        assert ed25519._predecomp_stats["hit"] == hits0 + 1
     finally:
         ed25519._PREDECOMP_MIN_BATCH = orig_min
         ed25519._predecomp.clear()
