@@ -354,6 +354,47 @@ def bench_coalesce_json(path: str = "BENCH_coalesce.json",
     return doc
 
 
+def bench_p2p_json(path: str = "BENCH_p2p.json",
+                   duration_s: float = 25.0) -> dict:
+    """Frame-plane trajectory point (ISSUE 3): the real-socket testnet
+    (4 OS processes, TCP + secret connections, 1,000-tx blocks) with the
+    burst frame plane ON vs OFF on the same host. Blocks/s from block
+    metas over the measured window; frames/burst and seal µs/frame come
+    from each arm's own /metrics scrape (tm_p2p_*), so the artifact
+    doubles as a live check of the new catalog."""
+    import bench_testnet
+
+    arms = {}
+    for mode in ("off", "on"):
+        print(f"[bench] p2p socket arm burst={mode}...",
+              file=sys.stderr, flush=True)
+        r = bench_testnet.run_socket(duration_s=duration_s, burst=mode)
+        arms[mode] = {
+            "blocks_per_sec": r["blocks_per_sec"],
+            "txs_per_sec": r["txs_per_sec"],
+            "avg_txs_per_block": r["avg_txs_per_block"],
+            "blocks": r["blocks"], "seconds": r["seconds"],
+            **r.get("p2p", {}),
+        }
+    off, on = arms["off"]["blocks_per_sec"], arms["on"]["blocks_per_sec"]
+    doc = {
+        "metric": "p2p_socket_burst_commit_rate",
+        "unit": "blocks/sec",
+        "workload": "4-validator socket testnet, 1000-tx blocks, "
+                    "WS tx spammers, shared host",
+        "source": "block metas over the measured window + each arm's "
+                  "tm_p2p_* /metrics scrape",
+        "knobs": {"TM_TPU_P2P_BURST": "off/on per arm",
+                  "duration_s_per_arm": duration_s},
+        "burst_off": arms["off"],
+        "burst_on": arms["on"],
+        "speedup": round(on / off, 2) if off else None,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def main() -> int:
     import numpy as np
     import jax
@@ -761,6 +802,11 @@ if __name__ == "__main__":
     if "--coalesce-json" in sys.argv:
         # standalone quick mode: only the BENCH_coalesce.json satellite
         print(json.dumps(bench_coalesce_json()), flush=True)
+        sys.exit(0)
+    if "--p2p-json" in sys.argv:
+        # standalone quick mode: only the BENCH_p2p.json satellite
+        # (socket testnet, burst frame plane on vs off)
+        print(json.dumps(bench_p2p_json()), flush=True)
         sys.exit(0)
     if "--verifier-json" in sys.argv:
         # standalone quick mode: only the BENCH_verifier.json satellite

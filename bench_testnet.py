@@ -135,8 +135,40 @@ def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
     }
 
 
+def _scrape_p2p_metrics(client) -> dict:
+    """Pull the frame-plane instruments from one node's /metrics
+    exposition (the nodes are separate OS processes — telemetry lives
+    behind their RPC, exactly where a production scrape would read)."""
+    import re
+    text = client.call("metrics")["exposition"]
+    vals = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = re.match(r'^(tm_p2p_[a-z_]+?)(\{[^}]*\})? ([0-9.e+-]+)$', line)
+        if not m:
+            continue
+        name, labels, v = m.group(1), m.group(2) or "", float(m.group(3))
+        vals[name + labels] = vals.get(name + labels, 0.0) + v
+    out = {}
+    fsum = vals.get('tm_p2p_frames_per_burst_sum{direction="send"}', 0.0)
+    fcnt = vals.get('tm_p2p_frames_per_burst_count{direction="send"}', 0.0)
+    if fcnt:
+        out["mean_frames_per_send_burst"] = round(fsum / fcnt, 2)
+    sealed = vals.get("tm_p2p_frames_sealed_total", 0.0)
+    seal_s = vals.get("tm_p2p_seal_seconds_sum", 0.0)
+    if sealed:
+        out["seal_us_per_frame"] = round(seal_s / sealed * 1e6, 2)
+        out["frames_sealed"] = int(sealed)
+    opened = vals.get("tm_p2p_frames_opened_total", 0.0)
+    open_s = vals.get("tm_p2p_open_seconds_sum", 0.0)
+    if opened:
+        out["open_us_per_frame"] = round(open_s / opened * 1e6, 2)
+    return out
+
+
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
-               duration_s: float = 25.0) -> dict:
+               duration_s: float = 25.0, burst: str = "") -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -158,6 +190,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
 
     from bench_util import free_port_block, node_child_env
     env = node_child_env(repo)
+    if burst:  # per-arm override for the frame-plane A/B (bench.py
+        #        --p2p-json); "" inherits whatever the caller exported
+        env["TM_TPU_P2P_BURST"] = burst
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -282,6 +317,10 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         h1 = clients[0].call("status")["latest_block_height"]
         dt = time.perf_counter() - t0
         stop.set()
+        try:
+            p2p_metrics = _scrape_p2p_metrics(clients[0])
+        except Exception:
+            p2p_metrics = {}
         txs = 0
         # the blockchain route caps at 20 metas per call: page through
         lo = h0 + 1
@@ -300,6 +339,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             "n_vals": n_vals, "seconds": round(dt, 1),
             "txs_injected": sum(sent),
             "transport": "tcp sockets, 4 OS processes, secret conns",
+            "burst": burst or "default",
+            "p2p": p2p_metrics,
         }
     except BaseException:
         # keep the net tree and surface log tails: the node logs are

@@ -26,7 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # instrumented module extends this set alongside docs/observability.md.
 KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
-    "rpc", "node", "storage", "evidence", "lite", "telemetry",
+    "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
 }
 
 INSTRUMENTED_MODULES = [
@@ -37,6 +37,9 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.mempool.mempool",
     "tendermint_tpu.blockchain.pool",
     "tendermint_tpu.p2p.switch",
+    "tendermint_tpu.p2p.conn.secret",    # tm_p2p_seal/open_seconds
+    "tendermint_tpu.p2p.conn.mconn",     # tm_p2p_frames_per_burst
+    "tendermint_tpu.types.events",       # tm_event_dropped_total
     "tendermint_tpu.rpc.core",
 ]
 

@@ -40,6 +40,13 @@ class BaseConfig:
     # Env TM_TPU_TELEMETRY=off overrides `telemetry` unconditionally.
     telemetry: bool = True
     telemetry_namespace: str = "tm"
+    # p2p burst frame plane (p2p/conn/burst.py): seal/open whole frame
+    # bursts in one native AEAD call and coalesce up to p2p_burst_max
+    # packets per link write. auto|on|off; TM_TPU_P2P_BURST (off|on|
+    # auto|<max packets>) wins over these. `off` restores the per-frame
+    # send/recv routines byte-for-byte.
+    p2p_burst: str = "auto"
+    p2p_burst_max: int = 0  # 0 = burst.DEFAULT_MAX_PACKETS (64)
 
 
 @dataclass

@@ -69,6 +69,19 @@ def _load():
         lib.tm_merkle_tree_proofs.restype = ctypes.c_uint64
         lib.tm_ed25519_prepare.argtypes = [u8p, u8p, u8p, u64p,
                                            ctypes.c_uint64, u8p, u8p]
+        try:
+            lib.tm_aead_seal_one.argtypes = [
+                u8p, u8p, u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p]
+            lib.tm_aead_seal_burst.argtypes = [
+                u8p, ctypes.c_uint64, ctypes.c_uint32, u8p, u64p,
+                ctypes.c_uint64, u8p]
+            lib.tm_aead_open_burst.argtypes = [
+                u8p, ctypes.c_uint64, ctypes.c_uint32, u8p, u64p,
+                ctypes.c_uint64, u8p]
+            lib.tm_aead_open_burst.restype = ctypes.c_int64
+        except AttributeError:
+            pass  # stale .so from before the AEAD kernels: hostops
+            #       still serves merkle/sha; aead_available() stays False
         _lib = lib
         return _lib
 
@@ -341,6 +354,164 @@ def merkle_tree_proofs(items: List[bytes]):
         proofs.append([raw[base + 32 * j:base + 32 * (j + 1)]
                        for j in range(depth)])
     return bytes(out_root), proofs
+
+
+# -- burst ChaCha20-Poly1305 (p2p secret-connection frame plane) ------------
+# One C call seals/opens a whole burst of length-prefixed frames (GIL
+# released by ctypes), replacing a Python AEAD round trip per <=1024-byte
+# frame. Gated behind an RFC 8439 self-check: if the compiled kernels do
+# not reproduce the §2.8.2 vector (and a burst round trip + tamper
+# rejection), the loader reports unavailable and callers stay on the
+# cryptography/purecrypto per-frame path.
+
+_aead_ok: Optional[bool] = None
+
+_RFC8439_KEY = bytes(range(0x80, 0xA0))
+_RFC8439_NONCE = bytes.fromhex("070000004041424344454647")
+_RFC8439_AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+_RFC8439_PT = (b"Ladies and Gentlemen of the class of '99: If I could "
+               b"offer you only one tip for the future, sunscreen would "
+               b"be it.")
+_RFC8439_CT_HEAD = bytes.fromhex("d31a8d34648e60db7b86afbc53ef7ec2")
+_RFC8439_TAG = bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+
+
+def _u8(data: bytes):
+    return (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
+        data or b"\x00")
+
+
+def _aead_self_check(lib) -> bool:
+    try:
+        # 1) RFC 8439 §2.8.2 seal vector (arbitrary nonce + aad)
+        out = (ctypes.c_uint8 * (len(_RFC8439_PT) + 16))()
+        lib.tm_aead_seal_one(_u8(_RFC8439_KEY), _u8(_RFC8439_NONCE),
+                             _u8(_RFC8439_AAD), len(_RFC8439_AAD),
+                             _u8(_RFC8439_PT), len(_RFC8439_PT), out)
+        sealed = bytes(out)
+        if sealed[:16] != _RFC8439_CT_HEAD or sealed[-16:] != _RFC8439_TAG:
+            return False
+        # 2) burst seal -> burst open round trip with counter nonces
+        key = bytes(range(32))
+        chunks = [b"", b"a", b"frame-two", b"x" * 1024]
+        wire = _aead_seal_burst_raw(lib, key, 5, chunks)
+        frames, pos = [], 0
+        while pos < len(wire):
+            clen = int.from_bytes(wire[pos:pos + 4], "big")
+            frames.append(wire[pos + 4:pos + 4 + clen])
+            pos += 4 + clen
+        opened = _aead_open_burst_raw(lib, key, 5, frames)
+        if opened is None or len(opened) != len(chunks):
+            return False
+        for chunk, plain in zip(chunks, opened):
+            dlen = int.from_bytes(plain[:2], "big")
+            if dlen != len(chunk) or plain[2:2 + dlen] != chunk:
+                return False
+        # 3) a flipped ciphertext bit must be rejected at its index
+        bad = bytearray(frames[2])
+        bad[0] ^= 1
+        if _aead_open_burst_raw(lib, key, 5,
+                                [frames[0], frames[1], bytes(bad)]) \
+                is not None:
+            return False
+        return True
+    except Exception:
+        return False
+
+
+def _aead_lib():
+    """The hostops lib, only once the AEAD kernels passed the RFC 8439
+    self-check; None otherwise."""
+    global _aead_ok
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_aead_seal_burst"):
+        return None
+    if _aead_ok is None:
+        _aead_ok = _aead_self_check(lib)
+    return lib if _aead_ok else None
+
+
+def aead_available() -> bool:
+    return _aead_lib() is not None
+
+
+def aead_seal_one(key: bytes, nonce12: bytes, aad: bytes,
+                  pt: bytes) -> Optional[bytes]:
+    """Single seal with an arbitrary nonce — the RFC-vector surface the
+    parity tests drive (the frame plane itself always uses the burst
+    entry points). -> ct||tag, or None when native is unavailable."""
+    lib = _aead_lib()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint8 * (len(pt) + 16))()
+    lib.tm_aead_seal_one(_u8(key), _u8(nonce12), _u8(aad), len(aad),
+                         _u8(pt), len(pt), out)
+    return bytes(out)
+
+
+def _nonce_split(nonce_start: int):
+    return nonce_start & 0xFFFFFFFFFFFFFFFF, (nonce_start >> 64) & 0xFFFFFFFF
+
+
+def _aead_seal_burst_raw(lib, key: bytes, nonce_start: int,
+                         chunks: List[bytes]) -> bytes:
+    buf, offsets = _pack(chunks)
+    total = sum(len(c) for c in chunks) + 22 * len(chunks)
+    out = (ctypes.c_uint8 * max(1, total))()
+    lo, hi = _nonce_split(nonce_start)
+    lib.tm_aead_seal_burst(_u8(key), lo, hi, buf, offsets, len(chunks), out)
+    return bytes(out)[:total]
+
+
+def _aead_open_burst_raw(lib, key: bytes, nonce_start: int,
+                         frames: List[bytes]) -> Optional[List[bytes]]:
+    buf, offsets = _pack(frames)
+    sizes = [max(0, len(f) - 16) for f in frames]
+    total = sum(sizes)
+    out = (ctypes.c_uint8 * max(1, total))()
+    lo, hi = _nonce_split(nonce_start)
+    rc = lib.tm_aead_open_burst(_u8(key), lo, hi, buf, offsets,
+                                len(frames), out)
+    if rc != len(frames):
+        return None
+    raw = bytes(out)[:total]
+    plains, pos = [], 0
+    for sz in sizes:
+        plains.append(raw[pos:pos + sz])
+        pos += sz
+    return plains
+
+
+def aead_seal_burst(key: bytes, nonce_start: int,
+                    chunks: List[bytes]) -> Optional[bytes]:
+    """Seal every chunk (payload WITHOUT its 2-byte length header) as one
+    SecretConnection frame each, counter nonces from nonce_start, and
+    return the concatenated wire bytes (be32 length prefix included per
+    frame) — byte-identical to per-frame sealing. None when the native
+    kernels are unavailable or failed their self-check."""
+    lib = _aead_lib()
+    if lib is None:
+        return None
+    return _aead_seal_burst_raw(lib, key, nonce_start, chunks)
+
+
+def aead_open_burst(key: bytes, nonce_start: int,
+                    frames: List[bytes]) -> Optional[List[bytes]]:
+    """Open sealed frames (ct||tag each, wire length prefix stripped)
+    with counter nonces from nonce_start. Returns the plaintexts (2-byte
+    length header still attached), or raises AeadTagError on the first
+    failing frame. None when native is unavailable."""
+    lib = _aead_lib()
+    if lib is None:
+        return None
+    out = _aead_open_burst_raw(lib, key, nonce_start, frames)
+    if out is None:
+        raise AeadTagError("burst frame failed AEAD authentication")
+    return out
+
+
+class AeadTagError(Exception):
+    """A burst frame failed Poly1305 authentication."""
 
 
 def merkle_proof(items: List[bytes], index: int):

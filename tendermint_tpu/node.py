@@ -52,6 +52,13 @@ class Node:
             enabled=getattr(config.base, "telemetry", True),
             namespace=getattr(config.base, "telemetry_namespace", "tm"))
 
+        # p2p burst frame plane knobs (env TM_TPU_P2P_BURST wins inside
+        # resolve(); connections snapshot these at creation time)
+        from tendermint_tpu.p2p.conn import burst as _burst
+        _burst.configure(
+            mode=getattr(config.base, "p2p_burst", "auto"),
+            max_packets=getattr(config.base, "p2p_burst_max", 0))
+
         def db_path(name):
             if in_memory:
                 return None

@@ -21,7 +21,14 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from tendermint_tpu import telemetry
+from tendermint_tpu.p2p.conn import burst as burst_cfg
 from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+
+_m_frames_per_burst = telemetry.histogram(
+    "p2p_frames_per_burst",
+    "Frames per coalesced link burst, by direction",
+    ("direction",), buckets=telemetry.POW2_BUCKETS)
 
 PACKET_PING = 0x01
 PACKET_PONG = 0x02
@@ -99,6 +106,14 @@ class MConnection:
         self._errored = False
         self._last_recv = time.monotonic()
         self._threads: List[threading.Thread] = []
+        # burst frame plane (ISSUE 3): coalesce up to _burst_max packets
+        # per link write (one AEAD burst + one sendall on a
+        # SecretConnection) and drain whole frame bursts on receive.
+        # Resolved once per connection; TM_TPU_P2P_BURST=off restores
+        # the per-frame code paths exactly.
+        self._burst_on, self._burst_max = burst_cfg.resolve()
+        self._burst_write = self._burst_on and hasattr(link, "write_many")
+        self._burst_read = self._burst_on and hasattr(link, "read_burst")
 
     # ---------------------------------------------------------------- control
 
@@ -219,9 +234,14 @@ class MConnection:
                     # block parts — on a shared-core testnet the wait/
                     # notify bookkeeping alone profiled at ~12% of node
                     # CPU. Priorities still hold: _pick_channel runs
-                    # per packet inside one acquisition.
+                    # per packet inside one acquisition. The burst cap
+                    # (config.base.p2p_burst_max / TM_TPU_P2P_BURST) is
+                    # also the unit the link seals+sends in one call
+                    # below; fair-share holds within a burst because
+                    # recently_sent advances per packet.
                     packets = []
-                    while len(packets) < 16:
+                    cap = self._burst_max if self._burst_write else 16
+                    while len(packets) < cap:
                         ch = self._pick_channel()
                         if ch is None:
                             break
@@ -246,9 +266,19 @@ class MConnection:
                     self.link.write(bytes([PACKET_PING]))
                     self.send_monitor.update(1)
                     last_ping = now
-                for packet in packets:
-                    self.link.write(packet)
-                    self.send_monitor.update(len(packet))
+                if self._burst_write and len(packets) > 1:
+                    # one AEAD burst + one sendall for the whole drain;
+                    # flowrate updates once per burst (payload bytes,
+                    # same units as the per-packet path)
+                    self.link.write_many(packets)
+                    self.send_monitor.update(
+                        sum(len(p) for p in packets))
+                    _m_frames_per_burst.labels("send").observe(
+                        len(packets))
+                else:
+                    for packet in packets:
+                        self.link.write(packet)
+                        self.send_monitor.update(len(packet))
                 # idle/death detection
                 if now - self._last_recv > self.idle_timeout:
                     raise ConnectionError(
@@ -261,38 +291,56 @@ class MConnection:
     def _recv_routine(self) -> None:
         try:
             while not self._stopped:
-                frame = self.link.read()
-                if frame == b"":
-                    raise ConnectionError("connection closed by peer")
-                self.recv_monitor.update(len(frame))
-                self._last_recv = time.monotonic()
-                ptype = frame[0]
-                if ptype == PACKET_PING:
-                    with self._cond:
-                        self._pong_due += 1
-                        self._cond.notify_all()
-                elif ptype == PACKET_PONG:
-                    pass
-                elif ptype == PACKET_MSG:
-                    ch_id, eof = frame[1], frame[2]
-                    ch = self.channels.get(ch_id)
-                    if ch is None:
-                        raise ValueError(f"unknown channel {ch_id:#x}")
-                    payload = frame[3:]
-                    ch.recv_len += len(payload)
-                    if ch.recv_len > ch.desc.recv_message_capacity:
-                        raise ValueError(
-                            f"recv msg exceeds capacity on ch {ch_id:#x}")
-                    ch.recv_buf.append(payload)
-                    if eof:
-                        msg = b"".join(ch.recv_buf)
-                        ch.recv_buf = []
-                        ch.recv_len = 0
-                        self.on_receive(ch_id, msg)
+                if self._burst_read:
+                    # drain every frame the link already buffered: one
+                    # AEAD open call for the burst, flowrate/keepalive
+                    # bookkeeping amortized once per burst
+                    frames = self.link.read_burst()
+                    if not frames:
+                        raise ConnectionError("connection closed by peer")
+                    self.recv_monitor.update(
+                        sum(len(f) for f in frames))
+                    if len(frames) > 1:
+                        _m_frames_per_burst.labels("recv").observe(
+                            len(frames))
                 else:
-                    raise ValueError(f"unknown packet type {ptype:#x}")
+                    frame = self.link.read()
+                    if frame == b"":
+                        raise ConnectionError("connection closed by peer")
+                    self.recv_monitor.update(len(frame))
+                    frames = (frame,)
+                self._last_recv = time.monotonic()
+                for frame in frames:
+                    self._handle_frame(frame)
         except Exception as e:
             self._error(e)
+
+    def _handle_frame(self, frame: bytes) -> None:
+        ptype = frame[0]
+        if ptype == PACKET_PING:
+            with self._cond:
+                self._pong_due += 1
+                self._cond.notify_all()
+        elif ptype == PACKET_PONG:
+            pass
+        elif ptype == PACKET_MSG:
+            ch_id, eof = frame[1], frame[2]
+            ch = self.channels.get(ch_id)
+            if ch is None:
+                raise ValueError(f"unknown channel {ch_id:#x}")
+            payload = frame[3:]
+            ch.recv_len += len(payload)
+            if ch.recv_len > ch.desc.recv_message_capacity:
+                raise ValueError(
+                    f"recv msg exceeds capacity on ch {ch_id:#x}")
+            ch.recv_buf.append(payload)
+            if eof:
+                msg = b"".join(ch.recv_buf)
+                ch.recv_buf = []
+                ch.recv_len = 0
+                self.on_receive(ch_id, msg)
+        else:
+            raise ValueError(f"unknown packet type {ptype:#x}")
 
 
 class PlainFramedConn:
@@ -302,19 +350,57 @@ class PlainFramedConn:
     def __init__(self, conn):
         self.conn = conn
         self._lock = threading.Lock()
+        self._rlock = threading.Lock()
+        self._rbuf = bytearray()
 
     def write(self, data: bytes) -> int:
         with self._lock:
             self.conn.sendall(struct.pack(">I", len(data)) + data)
             return len(data)
 
+    def write_many(self, chunks) -> int:
+        """One frame per chunk, one sendall for the burst — the
+        plaintext analogue of SecretConnection.write_many."""
+        with self._lock:
+            self.conn.sendall(b"".join(
+                struct.pack(">I", len(c)) + c for c in chunks))
+            return sum(len(c) for c in chunks)
+
     def read(self) -> bytes:
-        from tendermint_tpu.p2p.conn.secret import _read_exact
-        hdr = _read_exact(self.conn, 4, allow_eof=True)
-        if hdr == b"":
-            return b""
-        (n,) = struct.unpack(">I", hdr)
-        return _read_exact(self.conn, n)
+        with self._rlock:
+            frames = self._read_frames_locked(limit=1)
+            return frames[0] if frames else b""
+
+    def read_burst(self):
+        """Every complete frame already buffered; [] on clean EOF."""
+        with self._rlock:
+            return self._read_frames_locked(limit=0)
+
+    def _fill(self, need: int, allow_eof: bool = False) -> bool:
+        while len(self._rbuf) < need:
+            chunk = self.conn.recv(65536)
+            if not chunk:
+                if allow_eof and not self._rbuf:
+                    return False
+                raise ConnectionError("unexpected EOF")
+            self._rbuf += chunk
+        return True
+
+    def _read_frames_locked(self, limit: int = 0):
+        if not self._fill(4, allow_eof=True):
+            return []
+        frames = []
+        while len(self._rbuf) >= 4:
+            (n,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+            if len(self._rbuf) < 4 + n:
+                if frames:
+                    break
+                self._fill(4 + n)
+            frames.append(bytes(self._rbuf[4:4 + n]))
+            del self._rbuf[:4 + n]
+            if limit and len(frames) >= limit:
+                break
+        return frames
 
     def close(self) -> None:
         # shutdown first: close() alone neither wakes a recv() blocked in

@@ -23,7 +23,8 @@ from __future__ import annotations
 import socket as _socket
 import struct
 import threading
-from typing import Optional
+import time
+from typing import List, Optional
 
 # `cryptography` (OpenSSL) is OPTIONAL: its module-top import used to
 # kill collection of every test file that transitively imports the p2p
@@ -43,12 +44,32 @@ try:
 except ImportError:
     HAVE_CRYPTOGRAPHY = False
 
+from tendermint_tpu import native, telemetry
+from tendermint_tpu.p2p.conn import burst as burst_cfg
 from tendermint_tpu.p2p.conn import purecrypto
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.keys import PubKey
 
 DATA_MAX_SIZE = 1024  # plaintext bytes per frame (secret_connection.go:22)
 _TAG = 16             # poly1305 tag
+_RECV_CHUNK = 65536   # burst-mode socket read size
+
+# Frame-plane crypto timings, observed once per seal/open call (a call
+# covers a whole burst, so per-frame cost = _sum / frames). Buckets are
+# µs-scaled: a native burst seals ~10µs/frame, purecrypto ~4ms/frame.
+_AEAD_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 2.5e-2, 1e-1, 1.0)
+_m_seal = telemetry.histogram(
+    "p2p_seal_seconds", "AEAD seal wall time per call (burst = 1 call)",
+    buckets=_AEAD_BUCKETS)
+_m_open = telemetry.histogram(
+    "p2p_open_seconds", "AEAD open wall time per call (burst = 1 call)",
+    buckets=_AEAD_BUCKETS)
+# Frames under the calls above: seal µs/frame = seal_seconds_sum /
+# frames_sealed_total (what bench.py --p2p-json reports per arm).
+_m_sealed = telemetry.counter(
+    "p2p_frames_sealed_total", "Frames sealed (all paths)")
+_m_opened = telemetry.counter(
+    "p2p_frames_opened_total", "Frames opened (all paths)")
 
 
 def _hkdf(secret: bytes, info: bytes, n: int) -> bytes:
@@ -81,9 +102,13 @@ def _dh(priv, their_pub32: bytes) -> bytes:
 
 
 class _Cipher:
-    """One direction: ChaCha20Poly1305 with a 96-bit counter nonce."""
+    """One direction: ChaCha20Poly1305 with a 96-bit counter nonce. The
+    raw key is retained so the native burst kernels (which take key
+    bytes, not an AEAD object) share the same counter stream — burst and
+    per-frame calls may interleave freely on one cipher."""
 
     def __init__(self, key: bytes):
+        self.key = bytes(key)
         self.aead = _aead(key)
         self.nonce = 0
 
@@ -112,6 +137,13 @@ class SecretConnection:
         self._recv = recv_cipher
         self.remote_pubkey = remote_pubkey
         self._send_lock = threading.Lock()
+        # recv-side lock mirroring the send lock: two concurrent read()
+        # callers would otherwise interleave counter nonces (reader A
+        # takes nonce n, reader B nonce n+1, but B's frame arrives
+        # first) and poison the stream with spurious InvalidTags.
+        self._rlock = threading.Lock()
+        self._rbuf = bytearray()  # burst-mode socket read-ahead
+        self._burst = burst_cfg.resolve()[0]
 
     # ------------------------------------------------------------- handshake
 
@@ -149,22 +181,97 @@ class SecretConnection:
 
     def write(self, data: bytes) -> int:
         """Fragment into <=1024B plaintext frames (write in one lock so
-        concurrent writers cannot interleave nonce order)."""
+        concurrent writers cannot interleave nonce order). With burst on,
+        every frame of the payload seals in one native call and ships in
+        one sendall — same nonces, same wire bytes as the per-frame
+        path."""
         with self._send_lock:
+            if self._burst:
+                self._seal_and_send_locked(_chunk(data))
+                return len(data)
+            # pre-burst path, byte- and syscall-identical (escape hatch;
+            # the per-frame timing below is telemetry only)
             n = 0
+            tele = telemetry.enabled()
             view = memoryview(data)
             while True:
                 chunk = bytes(view[:DATA_MAX_SIZE])
                 view = view[len(chunk):]
+                t0 = time.perf_counter() if tele else 0.0
                 sealed = self._send.seal(struct.pack(">H", len(chunk)) + chunk)
+                if tele:
+                    _m_seal.observe(time.perf_counter() - t0)
+                    _m_sealed.inc()
                 self.conn.sendall(struct.pack(">I", len(sealed)) + sealed)
                 n += len(chunk)
                 if len(view) == 0:
                     break
             return n
 
+    def write_many(self, chunks: List[bytes]) -> int:
+        """Vectored frame write: each chunk (<=1024B) becomes exactly one
+        frame — the layout MConnection needs, where one frame is one
+        packet. The whole burst seals in one native call (GIL released)
+        and ships in one sendall; wire bytes are identical to calling
+        write(chunk) per chunk."""
+        total = 0
+        for c in chunks:
+            if len(c) > DATA_MAX_SIZE:
+                raise ValueError(f"frame chunk exceeds {DATA_MAX_SIZE}B")
+            total += len(c)
+        with self._send_lock:
+            if self._burst:
+                self._seal_and_send_locked(list(chunks))
+            else:
+                for chunk in chunks:
+                    sealed = self._send.seal(
+                        struct.pack(">H", len(chunk)) + chunk)
+                    self.conn.sendall(
+                        struct.pack(">I", len(sealed)) + sealed)
+        return total
+
+    def _seal_and_send_locked(self, chunks: List[bytes]) -> None:
+        t0 = time.perf_counter() if telemetry.enabled() else 0.0
+        wire = native.aead_seal_burst(self._send.key, self._send.nonce,
+                                      chunks)
+        if wire is not None:
+            self._send.nonce += len(chunks)
+        else:
+            # no native kernels: per-frame python seal, still one sendall
+            parts = []
+            for chunk in chunks:
+                sealed = self._send.seal(
+                    struct.pack(">H", len(chunk)) + chunk)
+                parts.append(struct.pack(">I", len(sealed)))
+                parts.append(sealed)
+            wire = b"".join(parts)
+        if t0:
+            _m_seal.observe(time.perf_counter() - t0)
+            _m_sealed.inc(len(chunks))
+        self.conn.sendall(wire)
+
     def read(self) -> bytes:
         """One frame's plaintext (<=1024B). b'' on clean EOF."""
+        with self._rlock:
+            if not self._burst:
+                return self._read_frame_unbuffered()
+            frames = self._read_frames_locked(limit=1)
+            return frames[0] if frames else b""
+
+    def read_burst(self) -> List[bytes]:
+        """Every complete frame already buffered from the socket, opened
+        in one native call — blocks only for the first. [] on clean EOF.
+        Interoperates frame-for-frame with a per-frame peer: burst is a
+        receive-side batching decision, not a wire format."""
+        with self._rlock:
+            if not self._burst:
+                frame = self._read_frame_unbuffered()
+                return [frame] if frame != b"" else []
+            return self._read_frames_locked(limit=0)
+
+    def _read_frame_unbuffered(self) -> bytes:
+        """The pre-burst read path (escape hatch): exact-size recvs,
+        one python AEAD open per frame."""
         hdr = _read_exact(self.conn, 4, allow_eof=True)
         if hdr == b"":
             return b""
@@ -172,13 +279,57 @@ class SecretConnection:
         if clen > DATA_MAX_SIZE + 2 + _TAG:
             raise ValueError(f"oversized secret frame: {clen}")
         sealed = _read_exact(self.conn, clen)
+        t0 = time.perf_counter() if telemetry.enabled() else 0.0
         plain = self._recv.open(sealed)
-        (dlen,) = struct.unpack(">H", plain[:2])
-        if 2 + dlen > len(plain):
-            raise ValueError(
-                f"secret frame length {dlen} exceeds plaintext "
-                f"({len(plain) - 2} data bytes)")
-        return plain[2:2 + dlen]
+        if t0:
+            _m_open.observe(time.perf_counter() - t0)
+            _m_opened.inc()
+        return _strip_frame(plain)
+
+    def _fill(self, need: int, allow_eof: bool = False) -> bool:
+        """Grow the read-ahead buffer to >= need bytes. False on clean
+        EOF (only when allow_eof and nothing is buffered)."""
+        while len(self._rbuf) < need:
+            chunk = self.conn.recv(_RECV_CHUNK)
+            if not chunk:
+                if allow_eof and not self._rbuf:
+                    return False
+                raise ConnectionError("unexpected EOF")
+            self._rbuf += chunk
+        return True
+
+    def _read_frames_locked(self, limit: int = 0) -> List[bytes]:
+        """Parse sealed frames out of the read-ahead buffer (blocking
+        until the first is complete), open them in one burst, and return
+        the payloads. limit=0 means every complete frame buffered."""
+        if not self._fill(4, allow_eof=True):
+            return []
+        sealed: List[bytes] = []
+        while len(self._rbuf) >= 4:
+            (clen,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+            if clen > DATA_MAX_SIZE + 2 + _TAG:
+                raise ValueError(f"oversized secret frame: {clen}")
+            if len(self._rbuf) < 4 + clen:
+                if sealed:
+                    break  # later frames: don't block mid-burst
+                self._fill(4 + clen)
+            sealed.append(bytes(self._rbuf[4:4 + clen]))
+            del self._rbuf[:4 + clen]
+            if limit and len(sealed) >= limit:
+                break
+        t0 = time.perf_counter() if telemetry.enabled() else 0.0
+        plains = None
+        if len(sealed) > 1:
+            plains = native.aead_open_burst(self._recv.key,
+                                            self._recv.nonce, sealed)
+            if plains is not None:
+                self._recv.nonce += len(sealed)
+        if plains is None:
+            plains = [self._recv.open(f) for f in sealed]
+        if t0:
+            _m_open.observe(time.perf_counter() - t0)
+            _m_opened.inc(len(sealed))
+        return [_strip_frame(p) for p in plains]
 
     def close(self) -> None:
         # shutdown wakes any recv() blocked in another thread and sends
@@ -191,6 +342,25 @@ class SecretConnection:
             self.conn.close()
         except OSError:
             pass
+
+
+def _chunk(data: bytes) -> List[bytes]:
+    """<=1024B plaintext chunks; an empty payload is one empty frame
+    (the pre-burst write loop sealed exactly that)."""
+    if not data:
+        return [b""]
+    view = memoryview(data)
+    return [bytes(view[i:i + DATA_MAX_SIZE])
+            for i in range(0, len(data), DATA_MAX_SIZE)]
+
+
+def _strip_frame(plain: bytes) -> bytes:
+    (dlen,) = struct.unpack(">H", plain[:2])
+    if 2 + dlen > len(plain):
+        raise ValueError(
+            f"secret frame length {dlen} exceeds plaintext "
+            f"({len(plain) - 2} data bytes)")
+    return plain[2:2 + dlen]
 
 
 def _read_exact(conn, n: int, allow_eof: bool = False) -> bytes:
