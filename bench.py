@@ -354,6 +354,57 @@ def bench_coalesce_json(path: str = "BENCH_coalesce.json",
     return doc
 
 
+def bench_chaos_json(path: str = "BENCH_chaos.json",
+                     seed: int = 42) -> dict:
+    """Chaos trajectory point (ISSUE 4): the full ACCEPTANCE_SPEC
+    scenario — drop/delay/duplicate/reorder link faults, a network
+    partition that heals, one crash-restart recovered through WAL +
+    handshake replay, one equivocating validator, and a half-rate
+    clock — on the seeded in-process 4-validator net, with the
+    invariant monitor (agreement / validity / evidence capture /
+    liveness) attached to every node's EventBus. The artifact records
+    faults injected by kind, invariant checks passed, the committed
+    double-sign evidence, and recovery-latency percentiles. The run is
+    fully deterministic: the same seed reproduces the identical fault
+    sequence (chaos/schedule.py)."""
+    from tendermint_tpu import telemetry
+    from tendermint_tpu.chaos.runner import ACCEPTANCE_SPEC, run_chaos
+
+    was_enabled = telemetry.enabled()
+    telemetry.set_enabled(True)
+    try:
+        report = run_chaos(seed=seed)
+    finally:
+        telemetry.set_enabled(was_enabled)
+    doc = {
+        "metric": "chaos_invariant_run",
+        "unit": "invariant checks passed",
+        "value": report["checks_total"] - len(report["violations"]),
+        "workload": "4-validator in-process net, seeded fault schedule "
+                    "(drop/delay/duplicate/reorder + partition&heal + "
+                    "crash-restart + equivocator + clock skew)",
+        "source": "chaos.monitor report (EventBus-attached oracle) + "
+                  "tm_chaos_* telemetry",
+        "seed": seed,
+        "spec": ACCEPTANCE_SPEC,
+        "faults_injected": report["faults_injected"],
+        "faults_injected_total": report["faults_injected_total"],
+        "invariant_checks": report["checks"],
+        "invariant_checks_total": report["checks_total"],
+        "violations": report["violations"],
+        "evidence": report["evidence"],
+        "recovery": report["recovery"],
+        "heights": report["heights"],
+        "max_height": report["max_height"],
+        "steps": report["steps"],
+        "wall_seconds": report["wall_seconds"],
+        "catchup_assists": report["catchup_assists"],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def bench_p2p_json(path: str = "BENCH_p2p.json",
                    duration_s: float = 25.0) -> dict:
     """Frame-plane trajectory point (ISSUE 3): the real-socket testnet
@@ -802,6 +853,11 @@ if __name__ == "__main__":
     if "--coalesce-json" in sys.argv:
         # standalone quick mode: only the BENCH_coalesce.json satellite
         print(json.dumps(bench_coalesce_json()), flush=True)
+        sys.exit(0)
+    if "--chaos-json" in sys.argv:
+        # standalone quick mode: only the BENCH_chaos.json satellite
+        # (seeded fault-injection run + invariant monitor report)
+        print(json.dumps(bench_chaos_json()), flush=True)
         sys.exit(0)
     if "--p2p-json" in sys.argv:
         # standalone quick mode: only the BENCH_p2p.json satellite

@@ -167,8 +167,23 @@ def _scrape_p2p_metrics(client) -> dict:
     return out
 
 
+def _scrape_chaos_metrics(client) -> dict:
+    """tm_chaos_faults_injected_total by kind from one node's /metrics
+    — evidence the chaos plane actually fired in a TM_TPU_CHAOS run."""
+    import re
+    text = client.call("metrics")["exposition"]
+    out = {}
+    for line in text.splitlines():
+        m = re.match(r'^tm_chaos_faults_injected_total\{kind="([a-z_]+)"\}'
+                     r' ([0-9.e+-]+)$', line)
+        if m:
+            out[m.group(1)] = int(float(m.group(2)))
+    return out
+
+
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
-               duration_s: float = 25.0, burst: str = "") -> dict:
+               duration_s: float = 25.0, burst: str = "",
+               chaos: str = "") -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -193,6 +208,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
     if burst:  # per-arm override for the frame-plane A/B (bench.py
         #        --p2p-json); "" inherits whatever the caller exported
         env["TM_TPU_P2P_BURST"] = burst
+    if chaos:  # chaos-plane link faults for every node (e.g.
+        #        "drop=0.02,delay=0.05,seed=7"); "" inherits caller env
+        env["TM_TPU_CHAOS"] = chaos
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -321,6 +339,13 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             p2p_metrics = _scrape_p2p_metrics(clients[0])
         except Exception:
             p2p_metrics = {}
+        chaos_metrics = {}
+        if chaos or os.environ.get("TM_TPU_CHAOS", "").strip() not in \
+                ("", "off"):
+            try:
+                chaos_metrics = _scrape_chaos_metrics(clients[0])
+            except Exception:
+                pass
         txs = 0
         # the blockchain route caps at 20 metas per call: page through
         lo = h0 + 1
@@ -341,6 +366,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             "transport": "tcp sockets, 4 OS processes, secret conns",
             "burst": burst or "default",
             "p2p": p2p_metrics,
+            **({"chaos": chaos, "chaos_faults": chaos_metrics}
+               if chaos_metrics else {}),
         }
     except BaseException:
         # keep the net tree and surface log tails: the node logs are

@@ -27,6 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
+    "chaos",
 }
 
 INSTRUMENTED_MODULES = [
@@ -41,6 +42,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.p2p.conn.mconn",     # tm_p2p_frames_per_burst
     "tendermint_tpu.types.events",       # tm_event_dropped_total
     "tendermint_tpu.rpc.core",
+    "tendermint_tpu.chaos",              # tm_chaos_* fault/invariant plane
 ]
 
 _LINE_RE = re.compile(

@@ -1,0 +1,205 @@
+"""InvariantMonitor — the chaos run's correctness oracle.
+
+Subscribes to every node's EventBus (the same bus RPC websockets use,
+so the monitor observes exactly what a client would) and checks, while
+faults fire:
+
+  agreement   no two nodes commit different blocks at one height —
+              the ≤1/3-byzantine safety claim, checked per commit.
+  validity    per node instance, committed heights strictly increase
+              (a node that re-announced or rewrote history trips this;
+              the tracker resets on crash-restart because catchup
+              replay legitimately re-covers the in-flight height).
+  evidence    every injected double-sign eventually appears as
+              DuplicateVoteEvidence committed in a block.
+  liveness    after every fault episode heals, the chain commits a new
+              height within a bounded number of steps.
+
+Violations are recorded (never raised mid-run — the runner must keep
+driving so the trace shows what happened AFTER the violation) and
+dumped as a replayable trace: {seed, spec, fault log, commit log,
+violations}. Re-running the runner with the trace's seed+spec
+reproduces the identical fault sequence.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from tendermint_tpu import chaos
+from tendermint_tpu.chaos.byzantine import double_sign_key
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+INVARIANTS = ("agreement", "validity", "evidence", "liveness")
+
+
+def _percentiles(xs: List[float]) -> dict:
+    if not xs:
+        return {}
+    s = sorted(xs)
+
+    def pct(p):
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    return {"p50": pct(0.50), "p90": pct(0.90), "max": s[-1],
+            "n": len(s)}
+
+
+class InvariantMonitor:
+    def __init__(self):
+        self._subs: Dict[int, object] = {}
+        # height -> {"hash": hex, "first_step": int, "nodes": {id: hex}}
+        self.commits: Dict[int, dict] = {}
+        self.node_height: Dict[int, int] = {}
+        self.commit_steps: List[tuple] = []   # (step, height) of FIRST commit
+        self.expected_double_signs: set = set()
+        self.committed_evidence: set = set()
+        self.violations: List[dict] = []
+        self.checks: Dict[str, int] = {}
+        self.max_height = 0
+
+    # ------------------------------------------------------------ wiring
+
+    def attach(self, node_id: int, event_bus) -> None:
+        """(Re-)subscribe to one node's bus. On crash-restart the node
+        carries a fresh bus; the validity tracker resets because replay
+        may legitimately re-commit the in-flight height."""
+        self._subs[node_id] = event_bus.subscribe(
+            f"chaos-monitor-{node_id}", "tm.event = 'NewBlock'",
+            capacity=4096)
+        self.node_height.pop(node_id, None)
+
+    def detach(self, node_id: int) -> None:
+        self._subs.pop(node_id, None)
+
+    # ------------------------------------------------------------ checking
+
+    def _check(self, invariant: str) -> None:
+        self.checks[invariant] = self.checks.get(invariant, 0) + 1
+        chaos.CHECKS.labels(invariant).inc()
+
+    def _violate(self, invariant: str, step: int, **detail) -> None:
+        self.violations.append(
+            {"invariant": invariant, "step": step, **detail})
+        chaos.VIOLATIONS.labels(invariant).inc()
+
+    def expect_double_sign(self, key: tuple) -> None:
+        self.expected_double_signs.add(key)
+
+    def poll(self, step: int) -> None:
+        """Drain every subscription; called once per runner step."""
+        for node_id, sub in list(self._subs.items()):
+            while True:
+                item = sub.get_nowait()
+                if item is None:
+                    break
+                data = item.data
+                self._on_commit(step, node_id, data["block"])
+
+    def _on_commit(self, step: int, node_id: int, block) -> None:
+        h = block.header.height
+        hash_hex = block.hash().hex()
+
+        # agreement: same height => same block, across every node
+        rec = self.commits.get(h)
+        if rec is None:
+            rec = self.commits[h] = {"hash": hash_hex, "first_step": step,
+                                     "nodes": {}}
+            self.commit_steps.append((step, h))
+        else:
+            self._check("agreement")
+            if rec["hash"] != hash_hex:
+                self._violate("agreement", step, height=h, node=node_id,
+                              hash=hash_hex, expected=rec["hash"])
+        rec["nodes"][node_id] = hash_hex
+
+        # validity: per node instance, heights strictly increase
+        self._check("validity")
+        last = self.node_height.get(node_id, 0)
+        if h <= last:
+            self._violate("validity", step, node=node_id, height=h,
+                          last=last)
+        self.node_height[node_id] = h
+        self.max_height = max(self.max_height, h)
+
+        # committed evidence harvest (for the evidence invariant)
+        for ev in block.evidence.evidence:
+            if isinstance(ev, DuplicateVoteEvidence):
+                self.committed_evidence.add(double_sign_key(ev.vote_a))
+
+    # ------------------------------------------------------------ finalize
+
+    def finalize(self, schedule, final_step: int,
+                 liveness_bound: int = 150,
+                 step_seconds: float = 0.0) -> dict:
+        """End-of-run checks + report. `step_seconds` (mean wall time
+        per runner step) converts step latencies into seconds for the
+        recovery histogram."""
+        # evidence: every injected double-sign must be committed
+        for key in sorted(self.expected_double_signs):
+            self._check("evidence")
+            if key not in self.committed_evidence:
+                self._violate("evidence", final_step, double_sign=key)
+
+        # liveness + recovery latency per healed fault episode
+        firsts = sorted(self.commit_steps)
+        latencies = []
+        episodes = []
+        for ep in schedule.episodes():
+            end = ep["end"]
+            if end > final_step:
+                continue  # episode never healed inside the run
+            self._check("liveness")
+            after = [s for s, _ in firsts if s >= end]
+            lat = (after[0] - end) if after else None
+            episodes.append({**ep, "recovery_steps": lat})
+            if lat is None or lat > liveness_bound:
+                self._violate("liveness", end, episode=ep,
+                              recovery_steps=lat,
+                              bound=liveness_bound)
+            if lat is not None:
+                latencies.append(lat)
+                if step_seconds > 0:
+                    chaos.RECOVERY.observe(lat * step_seconds)
+
+        lat_s = [x * step_seconds for x in latencies] if step_seconds \
+            else []
+        return {
+            "checks": dict(self.checks),
+            "checks_total": sum(self.checks.values()),
+            "violations": list(self.violations),
+            "heights": dict(self.node_height),
+            "max_height": self.max_height,
+            "evidence": {
+                "injected_double_signs": len(self.expected_double_signs),
+                "committed": len(self.committed_evidence
+                                 & self.expected_double_signs),
+            },
+            "recovery": {
+                "episodes": episodes,
+                "latency_steps": _percentiles([float(x)
+                                               for x in latencies]),
+                "latency_seconds": _percentiles(
+                    [round(x, 4) for x in lat_s]),
+            },
+        }
+
+    def dump_trace(self, path: str, schedule, report: Optional[dict] = None
+                   ) -> str:
+        """Replayable violation trace: everything needed to re-run the
+        exact fault sequence (see docs/robustness.md)."""
+        doc = {
+            "seed": schedule.seed,
+            "spec": schedule.spec,
+            "fault_log": schedule.log,
+            "fault_counts": schedule.counts,
+            "commits": {str(h): rec for h, rec in
+                        sorted(self.commits.items())},
+            "violations": self.violations,
+        }
+        if report is not None:
+            doc["report"] = report
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        return path

@@ -1,0 +1,431 @@
+"""ChaosNet — an in-process validator testnet under a FaultSchedule.
+
+Real Node assemblies (stores + WAL + handshake + EventBus + real
+EvidencePool) over the deterministic broadcast-relay transport the
+consensus tests use, driven by MockTickers — every source of timing is
+a runner step, so one seed reproduces one run exactly. The runner owns
+the network: each broadcast leaving a node enters a delivery queue
+where the schedule decides drop/delay/duplicate/reorder per
+destination; cross-partition traffic is buffered until the partition
+heals; byzantine nodes' messages pass through their ByzantineAgent
+first; crashes arm a utils/fail.py commit point around the victim's
+interactions and raise ChaosCrash — the node is torn down mid-commit
+and later rebuilt from its home dir (ABCI handshake + WAL catchup
+replay are the recovery under test).
+
+Catch-up assist: the broadcast relay has no consensus reactor, so a
+node that missed commit-forming messages would stall forever where the
+real stack re-gossips old-round votes to lagging peers. The runner
+plays that role deterministically: every delivered message is archived
+per height, and a node behind the committed frontier gets its next
+height's archive re-delivered (votes first, then proposal/parts — the
+same order reactor catch-up produces commits in).
+
+run_chaos() is the entry bench.py --chaos-json and the chaos tests
+share; ACCEPTANCE_SPEC is the full scenario the BENCH_chaos.json
+artifact commits (drop/delay/duplicate/reorder + partition&heal +
+crash-restart + equivocator + clock skew).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from tendermint_tpu.chaos.byzantine import ByzantineAgent, forget_locks
+from tendermint_tpu.chaos.monitor import InvariantMonitor
+from tendermint_tpu.chaos.schedule import FaultSchedule
+from tendermint_tpu.utils import fail
+
+RELAYED = ("proposal", "block_part", "vote")
+
+
+class ChaosCrash(BaseException):
+    """Simulated hard process death at a fail point. BaseException so
+    no handler between the fail point and the runner can swallow it —
+    the node must die with its disk state exactly as the crash left it
+    (the crashing input IS in the WAL: submit() saves before handling)."""
+
+
+# The artifact scenario: every required fault class in one seeded run.
+# Phases are staggered so the net always keeps a live +2/3 of honest
+# power: crash-restart of node 2 first, then a partition isolating node
+# 0 from the (healing) majority, with node 1 equivocating and node 3's
+# clock running at half rate throughout the middle of the run.
+ACCEPTANCE_SPEC = {
+    "drop": 0.03,
+    "delay": 0.08,
+    "delay_steps": [1, 3],
+    "duplicate": 0.03,
+    "reorder": 0.04,
+    "partitions": [{"start": 70, "stop": 110,
+                    "groups": [[0], [1, 2, 3]]}],
+    "crashes": [{"node": 2, "after_height": 3,
+                 "point": "consensus.before_save_block",
+                 "down_steps": 25}],
+    "clock_skew": {"3": 2},
+    "byzantine": [{"node": 1, "behavior": "equivocate",
+                   "start": 8, "stop": 130}],
+}
+
+# Tier-1 smoke scenario: drop + delay + one crash-restart, small enough
+# to finish in a few seconds on the 1-core CI host.
+SMOKE_SPEC = {
+    "drop": 0.02,
+    "delay": 0.06,
+    "delay_steps": [1, 2],
+    "crashes": [{"node": 2, "after_height": 2,
+                 "point": "consensus.after_wal_end_height",
+                 "down_steps": 12}],
+}
+
+
+class ChaosNet:
+    def __init__(self, workdir: str, spec: Optional[dict] = None,
+                 seed: int = 0, n: int = 4, chain_id: str = "chaos-net",
+                 tx_every: int = 4, assist_every: int = 8):
+        from tendermint_tpu.types import (GenesisDoc, GenesisValidator,
+                                          PrivKey)
+        self.workdir = workdir
+        self.n = n
+        self.chain_id = chain_id
+        self.tx_every = tx_every
+        self.assist_every = assist_every
+        self.schedule = FaultSchedule(spec, seed)
+        self.monitor = InvariantMonitor()
+        self.keys = [PrivKey.generate(bytes([i + 1]) * 32)
+                     for i in range(n)]
+        self.gen = GenesisDoc(
+            chain_id=chain_id, genesis_time_ns=1,
+            validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                        for k in self.keys])
+        self.agents = [ByzantineAgent(i, self.keys[i], chain_id,
+                                      self.schedule, self.monitor)
+                       for i in range(n)]
+        self.t = 0
+        self._seq = 0
+        self._outbox: List[tuple] = []       # (src, msg)
+        self._due: Dict[int, List[tuple]] = {}  # step -> [(seq, src, dst, msg)]
+        self._part_buf: List[tuple] = []     # (seq, src, dst, msg)
+        self._active_partitions: set = set()
+        self._archive: Dict[int, List[tuple]] = {}  # height -> [(src, msg)]
+        self._last_assist: Dict[int, int] = {}
+        self.assists = 0
+        self.nodes: List[Optional[object]] = [None] * n
+        self._t0 = time.perf_counter()
+        for i in range(n):
+            self.nodes[i] = self._build_node(i)
+
+    # --------------------------------------------------------------- assembly
+
+    def _home(self, i: int) -> str:
+        return os.path.join(self.workdir, f"node{i}")
+
+    def _build_node(self, i: int):
+        """Full Node over the node's (possibly pre-existing) home dir:
+        construction runs the ABCI handshake against a FRESH app, so a
+        rebuilt node replays its stored chain; start() runs WAL catchup
+        for the in-flight height."""
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.chaos.ticker import StepTicker
+        from tendermint_tpu.config import test_config
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.types.priv_validator import PrivValidatorFile
+
+        home = self._home(i)
+        pv_path = os.path.join(home, "priv_validator.json")
+        if os.path.exists(pv_path):
+            pv = PrivValidatorFile.load(pv_path)
+        else:
+            pv = PrivValidatorFile(pv_path, self.keys[i])
+            pv._persist()
+        node = Node(test_config(home), self.gen, priv_validator=pv,
+                    app=KVStoreApp())
+        node.consensus.ticker.stop()
+        node.consensus.ticker = StepTicker(
+            node.consensus._on_timeout_fire, clock=lambda: self.t,
+            skew=self.schedule.clock_skew.get(i, 1))
+        node.consensus.broadcast_hooks.append(
+            lambda msg, i=i: self._outbox.append((i, dict(msg)))
+            if msg.get("type") in RELAYED else None)
+        self.monitor.attach(i, node.event_bus)
+        return node
+
+    def start(self) -> None:
+        for node in self.nodes:
+            node.start()
+
+    def stop(self) -> None:
+        for i, node in enumerate(self.nodes):
+            if node is not None:
+                try:
+                    node.stop()
+                except Exception:
+                    pass
+            self.nodes[i] = None
+
+    # ------------------------------------------------------------- interacting
+
+    def _height(self, i: int) -> int:
+        node = self.nodes[i]
+        return node.consensus.state.last_block_height if node else -1
+
+    def _interact(self, i: int, fn) -> None:
+        """Run one interaction (ticker fire / message delivery) against
+        node i with its pending crash — if any — armed at the scheduled
+        fail point. Armed only for the duration of this interaction:
+        the fail-point registry is process-global, and the other nodes'
+        commits must pass through it untouched."""
+        crash = self.schedule.crash_for(i, self._height(i), self.t)
+        if crash is not None:
+            point = crash["point"]
+
+            def raiser(name):
+                raise ChaosCrash(f"node {i} at {name}")
+
+            fail.arm(point, raiser)
+        try:
+            fn()
+        except ChaosCrash:
+            crash["_fired"] = True
+            self._on_crash(i, crash)
+        finally:
+            if crash is not None and not crash.get("_fired"):
+                fail.disarm(crash["point"])
+
+    def _on_crash(self, i: int, crash: dict) -> None:
+        node = self.nodes[i]
+        self.nodes[i] = None
+        self.monitor.detach(i)
+        crash["crash_step"] = self.t
+        crash["restart_step"] = self.t + crash["down_steps"]
+        self.schedule.record("crash", self.t, node=i,
+                             point=crash["point"],
+                             height=node.consensus.rs.height)
+        # hard-stop: the consensus machine died mid-commit; releasing
+        # file handles is the OS's job on a real crash, ours here
+        node.consensus._stopped = True
+        try:
+            node.stop()
+        except Exception:
+            pass
+
+    def _restart(self, crash: dict) -> None:
+        i = crash["node"]
+        crash["_restarted"] = True
+        self.schedule.record("restart", self.t, node=i,
+                             crash_step=crash["crash_step"])
+        node = self._build_node(i)
+        self.nodes[i] = node
+        node.start()  # handshake already ran in the ctor; WAL catchup here
+
+    # --------------------------------------------------------------- stepping
+
+    def step(self) -> None:
+        self.t += 1
+        t = self.t
+
+        for c in self.schedule.crashes:
+            if c.get("_fired") and not c.get("_restarted") and \
+                    t >= c["restart_step"]:
+                self._restart(c)
+
+        for i, node in enumerate(self.nodes):
+            if node is not None and \
+                    self.schedule.byzantine_for(i, t) == "amnesia":
+                forget_locks(node.consensus, self.schedule, t, i)
+
+        if self.tx_every and t % self.tx_every == 0:
+            tx = b"chaos/t%d=v" % t
+            for node in self.nodes:
+                if node is None:
+                    continue
+                try:
+                    node.mempool.check_tx(tx)
+                except Exception:
+                    pass  # dup after restart replay / mempool full
+
+        for i, node in enumerate(self.nodes):
+            if node is not None:
+                self._interact(
+                    i, lambda n=node: n.consensus.ticker.fire_due())
+
+        self._route_outbox()
+        self._partition_transitions()
+        self._flush_partitions()
+        self._deliver_due()
+        self._assist()
+        self.monitor.poll(t)
+
+    def _route_outbox(self) -> None:
+        outbox, self._outbox = self._outbox, []
+        t = self.t
+        for src, msg in outbox:
+            behavior = self.schedule.byzantine_for(src, t)
+            msgs = self.agents[src].transform(t, behavior, msg) \
+                if behavior else [msg]
+            for m in msgs:
+                forged = m is not msg
+                self._archive.setdefault(
+                    _msg_height(m), []).append((src, m))
+                for dst in range(self.n):
+                    if dst == src or self.nodes[dst] is None:
+                        continue
+                    if self.schedule.cross_partition(t, src, dst):
+                        self._seq += 1
+                        self._part_buf.append((self._seq, src, dst, m))
+                        continue
+                    # chaos-forged traffic IS the fault — it bypasses
+                    # the link faults so the oracle tests the engine's
+                    # response to the attack, not the link's luck
+                    delays = [0] if forged else \
+                        self.schedule.link_deliveries(
+                            t, src, dst, m.get("type", "?"))
+                    for d in delays:
+                        self._seq += 1
+                        self._due.setdefault(t + d, []).append(
+                            (self._seq, src, dst, m))
+
+    def _partition_transitions(self) -> None:
+        t = self.t
+        now = {pi for pi, p in enumerate(self.schedule.partitions)
+               if p["start"] <= t < p["stop"]}
+        for pi in now - self._active_partitions:
+            self.schedule.record(
+                "partition", t,
+                groups=self.schedule.partitions[pi]["groups"])
+        for pi in self._active_partitions - now:
+            self.schedule.record("heal", t, partition=pi)
+        self._active_partitions = now
+
+    def _flush_partitions(self) -> None:
+        """Buffered cross-partition traffic whose partition healed is
+        released FIFO — a partition delays, it does not destroy (the
+        real network retransmits; destruction is the drop fault)."""
+        t = self.t
+        keep = []
+        for item in self._part_buf:
+            _, src, dst, m = item
+            if self.schedule.cross_partition(t, src, dst):
+                keep.append(item)
+            else:
+                self._due.setdefault(t, []).append(item)
+        self._part_buf = keep
+
+    def _deliver_due(self) -> None:
+        batch = sorted(self._due.pop(self.t, []))
+        for _, src, dst, m in batch:
+            node = self.nodes[dst]
+            if node is None:
+                continue  # the wire to a dead node drops everything
+            self._interact(dst, lambda n=node, mm=m, s=src: n.consensus.
+                           submit(dict(mm), peer_id=f"node{s}"))
+
+    def _assist(self) -> None:
+        """Reactor-style catch-up for nodes behind the committed
+        frontier (see module docstring)."""
+        t = self.t
+        frontier = max((self._height(i) for i in range(self.n)
+                        if self.nodes[i] is not None), default=0)
+        for i, node in enumerate(self.nodes):
+            if node is None or self._height(i) >= frontier:
+                continue
+            if t - self._last_assist.get(i, -10**9) < self.assist_every:
+                continue
+            self._last_assist[i] = t
+            want = self._height(i) + 1
+            msgs = self._archive.get(want, [])
+            if not msgs:
+                continue
+            self.assists += 1
+            ordered = ([m for m in msgs if m[1]["type"] == "vote"]
+                       + [m for m in msgs if m[1]["type"] == "proposal"]
+                       + [m for m in msgs if m[1]["type"] == "block_part"])
+            for src, m in ordered:
+                if src == i:
+                    continue
+                self._interact(i, lambda n=node, mm=m, s=src: n.consensus.
+                               submit(dict(mm), peer_id=f"assist{s}"))
+
+    # ----------------------------------------------------------------- driving
+
+    def run(self, target_height: int, max_steps: int = 800,
+            settle_steps: int = 60) -> None:
+        """Step until every live node reaches `target_height` AND every
+        scheduled fault window has opened and healed, then keep going
+        `settle_steps` more so late evidence lands in a block."""
+        while self.t < max_steps:
+            self.step()
+            live = [self._height(i) for i in range((self.n))
+                    if self.nodes[i] is not None]
+            if min(live, default=0) >= target_height and \
+                    self._faults_done():
+                break
+        for _ in range(settle_steps):
+            self.step()
+
+    def _faults_done(self) -> bool:
+        t = self.t
+        if any(not c.get("_restarted") for c in self.schedule.crashes):
+            return False
+        if any(t < p["stop"] for p in self.schedule.partitions):
+            return False
+        if any(t < b.get("stop", 0) for b in self.schedule.byzantine):
+            return False
+        return True
+
+    def report(self, liveness_bound: int = 150) -> dict:
+        wall = time.perf_counter() - self._t0
+        step_s = wall / max(1, self.t)
+        rep = self.monitor.finalize(self.schedule, self.t,
+                                    liveness_bound=liveness_bound,
+                                    step_seconds=step_s)
+        rep["seed"] = self.schedule.seed
+        rep["steps"] = self.t
+        rep["wall_seconds"] = round(wall, 3)
+        rep["step_seconds_mean"] = round(step_s, 5)
+        rep["faults_injected"] = dict(self.schedule.counts)
+        rep["faults_injected_total"] = sum(self.schedule.counts.values())
+        rep["catchup_assists"] = self.assists
+        return rep
+
+
+def _msg_height(m: dict) -> int:
+    t = m.get("type")
+    if t == "proposal":
+        return m["proposal"]["height"]
+    if t == "vote":
+        return m["vote"]["height"]
+    return m.get("height", 0)
+
+
+def run_chaos(spec: Optional[dict] = None, seed: int = 42,
+              workdir: Optional[str] = None, n: int = 4,
+              target_height: int = 10, max_steps: int = 800,
+              trace_path: Optional[str] = None) -> dict:
+    """One seeded chaos run end to end; returns the monitor report
+    (plus fault counts). Used by bench.py --chaos-json and the tests.
+    On any violation a replayable trace is dumped next to the workdir
+    (or at `trace_path`)."""
+    import shutil
+    import tempfile
+    spec = ACCEPTANCE_SPEC if spec is None else spec
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-net-")
+    net = ChaosNet(workdir, spec, seed, n=n)
+    try:
+        net.start()
+        net.run(target_height, max_steps=max_steps)
+        report = net.report()
+        if report["violations"] or trace_path:
+            # never inside a workdir this function is about to delete
+            path = trace_path or os.path.join(
+                tempfile.gettempdir(), f"chaos_trace_{seed}.json")
+            net.monitor.dump_trace(path, net.schedule, report)
+            report["trace"] = path
+        return report
+    finally:
+        net.stop()
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)

@@ -1,0 +1,173 @@
+"""FaultSchedule — seeded, declarative fault orchestration.
+
+One schedule instance owns ALL randomness of a chaos run: every
+decision (drop/delay/duplicate/reorder per message, partition windows,
+crash points, clock skew, byzantine windows) is drawn from one seeded
+RNG in the deterministic order the single-threaded runner asks for
+them, so the same (spec, seed) pair produces an identical fault
+sequence — the acceptance contract that makes violation traces
+replayable.
+
+Spec (plain dict, JSON-serializable so traces can embed it):
+
+    {
+      "drop": 0.05,             # P(drop) per (message, destination)
+      "delay": 0.10,            # P(delay) per delivery
+      "delay_steps": [1, 4],    # delay range, in runner steps
+      "duplicate": 0.03,        # P(second delivery of the same message)
+      "reorder": 0.05,          # P(pushed behind later traffic by 1 step)
+      "partitions": [           # cross-group traffic buffered until stop
+        {"start": 30, "stop": 60, "groups": [[0], [1, 2, 3]]}
+      ],
+      "crashes": [              # hard-kill at a named commit fail point
+        {"node": 2, "after_height": 3,
+         "point": "consensus.before_save_block", "down_steps": 20}
+      ],
+      "clock_skew": {"1": 2},   # node 1's consensus clock runs 2x slow
+                                # (chaos.ticker.StepTicker skew factor)
+      "byzantine": [            # see chaos.byzantine for behaviors
+        {"node": 0, "behavior": "equivocate", "start": 5, "stop": 80}
+      ],
+    }
+
+Every field is optional; omitted faults never fire. Crash points must
+name a utils/fail.py COMMIT_POINTS entry — a typo would silently never
+crash, so the constructor validates them.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from tendermint_tpu.utils.fail import COMMIT_POINTS
+
+_RATE_KEYS = ("drop", "delay", "duplicate", "reorder")
+
+
+class FaultSchedule:
+    def __init__(self, spec: Optional[dict] = None, seed: int = 0):
+        spec = dict(spec or {})
+        self.seed = int(seed)
+        self.spec = spec
+        self._rng = random.Random(self.seed)
+        self.rates = {k: float(spec.get(k, 0.0)) for k in _RATE_KEYS}
+        lo, hi = spec.get("delay_steps", (1, 3))
+        self.delay_lo, self.delay_hi = int(lo), int(hi)
+        self.partitions = [dict(p) for p in spec.get("partitions", ())]
+        for p in self.partitions:
+            p["groups"] = [list(g) for g in p["groups"]]
+        self.crashes = [dict(c) for c in spec.get("crashes", ())]
+        for c in self.crashes:
+            point = c.setdefault("point", COMMIT_POINTS[0])
+            if point not in COMMIT_POINTS:
+                raise ValueError(
+                    f"unknown crash point {point!r} "
+                    f"(known: {COMMIT_POINTS})")
+            c.setdefault("down_steps", 20)
+            c.setdefault("after_height", 1)
+        self.clock_skew: Dict[int, int] = {
+            int(k): int(v) for k, v in spec.get("clock_skew", {}).items()}
+        self.byzantine = [dict(b) for b in spec.get("byzantine", ())]
+        # fault event log: the replayable record (and the determinism
+        # witness — two runs with one seed must produce equal logs)
+        self.log: List[dict] = []
+        self.counts: Dict[str, int] = {}
+
+    # ---------------------------------------------------------------- record
+
+    def record(self, kind: str, step: int, **detail) -> None:
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        self.log.append({"kind": kind, "step": step, **detail})
+        from tendermint_tpu import chaos
+        chaos.record_fault(kind)
+
+    # ----------------------------------------------------------- link faults
+
+    def link_deliveries(self, step: int, src: int, dst: int,
+                        msg_type: str) -> List[int]:
+        """Delivery delays (in steps) for one (message, dst): [] = drop,
+        [0] = now, [2] = delayed, [0, 1] = duplicated. Consensus-critical
+        and chaos-forged messages alike pass through here — the runner
+        decides what to feed."""
+        r = self.rates
+        if r["drop"] and self._rng.random() < r["drop"]:
+            self.record("drop", step, src=src, dst=dst, msg=msg_type)
+            return []
+        delay = 0
+        if r["delay"] and self._rng.random() < r["delay"]:
+            delay = self._rng.randint(self.delay_lo, self.delay_hi)
+            self.record("delay", step, src=src, dst=dst, msg=msg_type,
+                        steps=delay)
+        elif r["reorder"] and self._rng.random() < r["reorder"]:
+            # pushed behind the traffic of the next step: genuine
+            # reordering relative to everything sent after it
+            delay = 1
+            self.record("reorder", step, src=src, dst=dst, msg=msg_type)
+        out = [delay]
+        if r["duplicate"] and self._rng.random() < r["duplicate"]:
+            out.append(delay + self._rng.randint(0, 2))
+            self.record("duplicate", step, src=src, dst=dst, msg=msg_type)
+        return out
+
+    # ------------------------------------------------------------ partitions
+
+    def partition_of(self, step: int, node: int) -> Optional[tuple]:
+        """(partition_index, group_index) when `node` sits in an active
+        partition at `step`, else None."""
+        for pi, p in enumerate(self.partitions):
+            if p["start"] <= step < p["stop"]:
+                for gi, group in enumerate(p["groups"]):
+                    if node in group:
+                        return (pi, gi)
+        return None
+
+    def cross_partition(self, step: int, src: int, dst: int) -> bool:
+        a, b = self.partition_of(step, src), self.partition_of(step, dst)
+        if a is None and b is None:
+            return False
+        return a != b
+
+    # ------------------------------------------------------ crashes/byzantine
+
+    def crash_for(self, node: int, height: int,
+                  step: int) -> Optional[dict]:
+        """The pending crash event for `node` once it has committed
+        `after_height` — one-shot (consumed by the runner)."""
+        for c in self.crashes:
+            if not c.get("_fired") and c["node"] == node and \
+                    height >= c["after_height"]:
+                return c
+        return None
+
+    def byzantine_for(self, node: int, step: int) -> Optional[str]:
+        for b in self.byzantine:
+            if b["node"] == node and \
+                    b.get("start", 0) <= step < b.get("stop", 1 << 30):
+                return b["behavior"]
+        return None
+
+    # --------------------------------------------------------------- windows
+
+    def episodes(self) -> List[dict]:
+        """Fault windows with known end points, for the monitor's
+        liveness/recovery bookkeeping. Crash ends are stamped by the
+        runner at restart time (actual step recorded in the event)."""
+        out = []
+        for p in self.partitions:
+            out.append({"kind": "partition", "start": p["start"],
+                        "end": p["stop"]})
+        for b in self.byzantine:
+            if "stop" in b:
+                out.append({"kind": f"byzantine:{b['behavior']}",
+                            "start": b.get("start", 0), "end": b["stop"]})
+        for e in self.log:
+            if e["kind"] == "restart":
+                out.append({"kind": "crash", "start": e["crash_step"],
+                            "end": e["step"], "node": e["node"]})
+        return out
+
+    def signature(self) -> List[tuple]:
+        """Compact deterministic digest of the fault sequence (the
+        same-seed acceptance check compares two of these)."""
+        return [tuple(sorted(e.items())) for e in self.log]

@@ -47,6 +47,14 @@ class BaseConfig:
     # send/recv routines byte-for-byte.
     p2p_burst: str = "auto"
     p2p_burst_max: int = 0  # 0 = burst.DEFAULT_MAX_PACKETS (64)
+    # chaos plane (chaos/): deterministic fault injection. "off" (the
+    # default) is a zero-overhead no-op — p2p links stay on the
+    # existing code paths byte-for-byte. Any other value is a link
+    # fault spec, e.g. "drop=0.05,delay=0.1,delay_ms=30"; chaos_seed
+    # makes the injected fault pattern reproducible. Env TM_TPU_CHAOS
+    # (which may carry its own seed=N) wins over both.
+    chaos: str = "off"
+    chaos_seed: int = 0
 
 
 @dataclass

@@ -671,21 +671,21 @@ class ConsensusState:
             raise ConsensusFailure(f"+2/3 committed invalid block: {e}") from e
 
         from tendermint_tpu.utils import fail
-        fail.fail_point("before save_block")
+        fail.fail_point("consensus.before_save_block")
         if self.block_store.height() < block.header.height:
             seen_commit = pc.make_commit()
             self.block_store.save_block(block, parts, seen_commit)
 
-        fail.fail_point("before wal end_height")
+        fail.fail_point("consensus.before_wal_end_height")
         # ENDHEIGHT marks the WAL before ApplyBlock: if we crash between
         # the two, handshake replay redoes ApplyBlock (consensus/replay.go)
         self.wal.save_end_height(height)
-        fail.fail_point("after wal end_height")
+        fail.fail_point("consensus.after_wal_end_height")
 
         block_id = BlockID(block.hash(), parts.header())
         new_state = self.block_exec.apply_block(
             self.state.copy(), block_id, block)
-        fail.fail_point("after apply_block")
+        fail.fail_point("consensus.after_apply_block")
 
         if self.decided_hook is not None:
             self.decided_hook(block)

@@ -59,6 +59,13 @@ class Node:
             mode=getattr(config.base, "p2p_burst", "auto"),
             max_packets=getattr(config.base, "p2p_burst_max", 0))
 
+        # chaos plane knobs (env TM_TPU_CHAOS wins inside resolve();
+        # "off" keeps every hot path on the existing code byte-for-byte)
+        from tendermint_tpu import chaos as _chaos
+        _chaos.configure(
+            mode=getattr(config.base, "chaos", "off"),
+            seed=getattr(config.base, "chaos_seed", 0))
+
         def db_path(name):
             if in_memory:
                 return None

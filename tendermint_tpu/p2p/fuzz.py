@@ -3,7 +3,24 @@
 Wraps any link (write/read/close) and randomly drops writes, delays
 reads/writes, or kills the connection — the reference's FuzzedConnection
 with mode=drop (p=0.2 default) / mode=delay (:10-47). Used by tests to
-assert reactors survive a lossy transport."""
+assert reactors survive a lossy transport.
+
+Two extensions over the reference:
+
+- Vectored passthrough (ISSUE 4 satellite): burst-mode links
+  (SecretConnection/PlainFramedConn `write_many`/`read_burst`) are
+  fuzzed PER FRAME, so a connection that upgraded to the burst frame
+  plane (PR 3) cannot silently bypass fault injection. When the inner
+  link lacks the vectored API the wrapper degrades to per-frame calls,
+  so FuzzedLink always presents the full link surface.
+
+- Deterministic decider: a `decider(op)` callable replaces the
+  probability draws with externally scheduled decisions — the chaos
+  plane's FaultSchedule drives drop/delay deterministically from one
+  seed. Return None/"pass" to deliver, "drop" to drop, ("delay", s) to
+  sleep s seconds first. `on_fault(kind)` observes every injected
+  fault (telemetry counting lives in tendermint_tpu.chaos, not here).
+"""
 
 from __future__ import annotations
 
@@ -25,15 +42,37 @@ class FuzzConfig:
 
 
 class FuzzedLink:
-    def __init__(self, link, config: FuzzConfig | None = None):
+    def __init__(self, link, config: FuzzConfig | None = None,
+                 decider=None, on_fault=None):
         self.link = link
         self.config = config or FuzzConfig()
+        self.decider = decider
+        self.on_fault = on_fault
         self._rng = random.Random(self.config.seed)
         self._lock = threading.Lock()
         self._dead = False
 
-    def _fuzz(self) -> bool:
+    def _note(self, kind: str) -> None:
+        if self.on_fault is not None:
+            self.on_fault(kind)
+
+    def _fuzz(self, op: str = "rw") -> bool:
         """True = drop this operation (fuzz.go:132)."""
+        if self.decider is not None:
+            with self._lock:
+                if self._dead:
+                    raise ConnectionError("fuzzed connection killed")
+                action = self.decider(op)
+            if action in (None, "pass"):
+                return False
+            if action == "drop":
+                self._note("drop")
+                return True
+            if isinstance(action, tuple) and action[0] == "delay":
+                self._note("delay")
+                time.sleep(action[1])
+                return False
+            raise ValueError(f"unknown fuzz action {action!r}")
         cfg = self.config
         with self._lock:
             if self._dead:
@@ -42,27 +81,63 @@ class FuzzedLink:
                 if cfg.prob_drop_conn > 0 and \
                         self._rng.random() < cfg.prob_drop_conn:
                     self._dead = True
+                    self._note("kill")
                     raise ConnectionError("fuzzed connection killed")
                 if self._rng.random() < cfg.prob_drop_rw:
+                    self._note("drop")
                     return True
             elif cfg.mode == "delay":
                 if cfg.prob_sleep > 0 and self._rng.random() < cfg.prob_sleep:
+                    self._note("delay")
                     time.sleep(self._rng.random() * cfg.max_delay_s)
         return False
 
     def write(self, data: bytes) -> int:
-        if self._fuzz():
+        if self._fuzz("write"):
             return len(data)  # silently dropped
         return self.link.write(data)
+
+    def write_many(self, chunks) -> int:
+        """Per-frame fuzz over a burst: survivors still go out as ONE
+        vectored write when the substrate supports it (the wire stays
+        burst-framed); callers observe full acceptance, dropped frames
+        just never reach the wire — same contract as write()."""
+        chunks = list(chunks)
+        kept = [c for c in chunks if not self._fuzz("write")]
+        if kept:
+            inner = getattr(self.link, "write_many", None)
+            if inner is not None:
+                inner(kept)
+            else:
+                for c in kept:
+                    self.link.write(c)
+        return sum(len(c) for c in chunks)
 
     def read(self) -> bytes:
         while True:
             frame = self.link.read()
             if frame == b"":
                 return b""
-            if self._fuzz():
+            if self._fuzz("read"):
                 continue  # drop received frame
             return frame
+
+    def read_burst(self):
+        """Per-frame fuzz over a received burst; loops until at least
+        one frame survives ([] only on clean EOF, matching the burst
+        link contract)."""
+        inner = getattr(self.link, "read_burst", None)
+        while True:
+            if inner is not None:
+                frames = inner()
+            else:
+                f = self.link.read()
+                frames = [f] if f != b"" else []
+            if not frames:
+                return []
+            kept = [f for f in frames if not self._fuzz("read")]
+            if kept:
+                return kept
 
     def close(self) -> None:
         self.link.close()

@@ -288,6 +288,11 @@ class Switch:
             raise
 
         sock.settimeout(None)
+        # chaos plane: schedule-driven lossy-link wrapper, or — the
+        # default, TM_TPU_CHAOS=off — the link back unchanged, keeping
+        # the frame hot path byte-for-byte on the existing code
+        from tendermint_tpu.chaos import maybe_wrap_link
+        link = maybe_wrap_link(link, their_info.id or "")
         peer = Peer(
             link, their_info, self.channel_descs, outbound=outbound,
             persistent=persistent, dial_addr=dial_addr,

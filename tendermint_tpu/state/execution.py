@@ -157,11 +157,11 @@ class BlockExecutor:
         self.validate_block(state, block,
                             trust_last_commit=trust_last_commit)
         responses = exec_block_on_app(self.app_conn, block, state.validators)
-        fail.fail_point("after exec_block")
+        fail.fail_point("execution.after_exec_block")
         if self.state_store is not None:
             self.state_store.save_abci_responses(
                 block.header.height, responses.to_obj())
-        fail.fail_point("after save_abci_responses")
+        fail.fail_point("execution.after_save_abci_responses")
         new_state = update_state(state, block_id, block, responses)
 
         # Commit app + update mempool under the mempool lock
@@ -174,11 +174,11 @@ class BlockExecutor:
         finally:
             self.mempool.unlock()
 
-        fail.fail_point("after app commit + mempool update")
+        fail.fail_point("execution.after_app_commit")
         new_state.app_hash = app_hash
         if self.state_store is not None:
             self.state_store.save(new_state)
-        fail.fail_point("after save_state")
+        fail.fail_point("execution.after_save_state")
         self.evidence_pool.update(block, new_state)
         if self.event_bus is not None:
             fire_events(self.event_bus, block, block_id, responses)

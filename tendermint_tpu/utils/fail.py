@@ -2,9 +2,30 @@
 consensus/state.go:1179-1228, state/execution.go:82-107).
 
 Each `fail_point()` call increments a process-global counter; when the
-counter reaches $FAIL_TEST_INDEX the process dies hard (os._exit), so
+counter reaches the target index the process dies hard (os._exit), so
 crash-recovery tests can kill a node at EVERY commit-critical step and
-assert it recovers (test/persist/test_failure_indices.sh's loop)."""
+assert it recovers (test/persist/test_failure_indices.sh's loop). The
+target comes from $FAIL_TEST_INDEX (the reference's env contract, wins
+when set) or from set_target() for in-process sweeps that must not leak
+state through the environment.
+
+Two test hooks replace the hard exit:
+
+- set_callback(cb): `cb(index)` runs instead of os._exit when the
+  counter hits the target. clear_callback() removes it; tests/conftest
+  resets both after every test so a forgotten hook can't leak into the
+  next one.
+- arm(name, cb): one-shot NAMED trigger — the next fail_point(name)
+  with that exact name invokes `cb(name)` (which may raise to simulate
+  a crash) regardless of any counter. This is the chaos runner's crash
+  plane: it arms a commit-critical point only around interactions with
+  the victim node, so an in-process multi-node net can crash one node
+  deterministically while the others keep the shared counter untouched.
+
+Every commit-critical call site uses a stable dotted name from
+COMMIT_POINTS (in per-commit execution order), so schedules and docs
+can reference them without grepping the code.
+"""
 
 from __future__ import annotations
 
@@ -14,7 +35,22 @@ import threading
 
 _lock = threading.Lock()
 _counter = 0
-_callback = None  # test hook: replaces os._exit when set
+_callback = None  # test hook: replaces os._exit when the target index hits
+_target = None    # programmatic FAIL_TEST_INDEX (env wins when both set)
+_armed: dict = {}  # name -> one-shot callback
+
+# The commit-critical fail points, in the order one commit passes them
+# (consensus/state.py _finalize_commit -> state/execution.py apply_block).
+COMMIT_POINTS = (
+    "consensus.before_save_block",
+    "consensus.before_wal_end_height",
+    "consensus.after_wal_end_height",
+    "execution.after_exec_block",
+    "execution.after_save_abci_responses",
+    "execution.after_app_commit",
+    "execution.after_save_state",
+    "consensus.after_apply_block",
+)
 
 
 def reset() -> None:
@@ -26,20 +62,64 @@ def reset() -> None:
 def set_callback(cb) -> None:
     """Testing: call `cb(index)` instead of killing the process."""
     global _callback
-    _callback = cb
+    with _lock:
+        _callback = cb
+
+
+def clear_callback() -> None:
+    global _callback
+    with _lock:
+        _callback = None
+
+
+def set_target(index) -> None:
+    """Programmatic FAIL_TEST_INDEX (None disables). The env var, when
+    set, still wins — the subprocess matrix tests keep their contract."""
+    global _target
+    with _lock:
+        _target = None if index is None else int(index)
+
+
+def arm(name: str, cb) -> None:
+    """One-shot: the next fail_point(name) calls `cb(name)`."""
+    with _lock:
+        _armed[name] = cb
+
+
+def disarm(name: str) -> None:
+    with _lock:
+        _armed.pop(name, None)
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
 
 
 def fail_point(name: str = "") -> None:
     global _counter
-    target = os.environ.get("FAIL_TEST_INDEX")
-    if target is None:
+    # fast path: nothing armed, no target anywhere — one dict truthiness
+    # check + one env lookup, no lock (commit paths call this 8x/commit)
+    env_target = os.environ.get("FAIL_TEST_INDEX")
+    if not _armed and _target is None and env_target is None:
+        return
+    armed_cb = None
+    if _armed and name:
+        with _lock:
+            armed_cb = _armed.pop(name, None)
+    if armed_cb is not None:
+        armed_cb(name)  # may raise: the chaos runner's simulated crash
         return
     with _lock:
+        target = int(env_target) if env_target is not None else _target
+        if target is None:
+            return
         _counter += 1
         current = _counter
-    if current == int(target):
-        if _callback is not None:
-            _callback(current)
+        cb = _callback
+    if current == target:
+        if cb is not None:
+            cb(current)
             return
         sys.stderr.write(f"FAIL_TEST_INDEX {current} hit at "
                          f"{name or 'unnamed'} — exiting\n")

@@ -47,6 +47,26 @@ import jax  # noqa: E402  (after env setup, before any backend use)
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the long chaos schedules (full
+    # acceptance scenario, partition/byzantine sweeps) opt out with it
+    config.addinivalue_line(
+        "markers", "slow: long-running schedule, excluded from tier-1")
+
+
+@pytest.fixture(autouse=True)
+def _reset_fail_points():
+    """Fail-point hooks are process-global; a test that set a callback,
+    a programmatic target, or an armed named trigger and raised before
+    clearing it would silently redirect the NEXT test's commits."""
+    yield
+    from tendermint_tpu.utils import fail
+    fail.clear_callback()
+    fail.set_target(None)
+    fail.disarm_all()
+    fail.reset()
+
+
 @pytest.fixture(autouse=True)
 def _no_leaked_tm_threads():
     """Leaktest (the reference runs fortytw2/leaktest on its goroutine

@@ -1,0 +1,212 @@
+"""Chaos plane tests: seeded fault schedule determinism, the tier-1
+smoke scenario (drop + delay + one crash-restart, seconds on the CI
+host), the zero-overhead off-hatch, monitor self-checks (an oracle that
+cannot fail proves nothing), and the full acceptance scenario (slow)."""
+
+import shutil
+import tempfile
+import types
+
+import pytest
+
+from tendermint_tpu.chaos.monitor import InvariantMonitor
+from tendermint_tpu.chaos.schedule import FaultSchedule
+
+
+# --------------------------------------------------------- schedule --
+
+def _drive(schedule, n=300):
+    """Synthetic deterministic event stream through every decision."""
+    for step in range(n):
+        schedule.link_deliveries(step, step % 4, (step + 1) % 4, "vote")
+
+
+def test_same_seed_identical_fault_sequence():
+    spec = {"drop": 0.1, "delay": 0.2, "duplicate": 0.05,
+            "reorder": 0.05}
+    a, b = FaultSchedule(spec, seed=11), FaultSchedule(spec, seed=11)
+    _drive(a)
+    _drive(b)
+    assert a.signature() == b.signature()
+    assert a.counts == b.counts and a.counts  # faults actually fired
+
+    c = FaultSchedule(spec, seed=12)
+    _drive(c)
+    assert a.signature() != c.signature()
+
+
+def test_schedule_rejects_unknown_crash_point():
+    with pytest.raises(ValueError, match="unknown crash point"):
+        FaultSchedule({"crashes": [{"node": 0, "point": "no_such"}]})
+
+
+def test_partition_and_skew_lookup():
+    s = FaultSchedule({"partitions": [{"start": 10, "stop": 20,
+                                       "groups": [[0], [1, 2]]}],
+                       "clock_skew": {"2": 3}})
+    assert s.cross_partition(15, 0, 1)
+    assert not s.cross_partition(15, 1, 2)
+    assert not s.cross_partition(25, 0, 1)  # healed
+    assert s.clock_skew == {2: 3}
+
+
+# ------------------------------------------------------------ knobs --
+
+def test_chaos_off_is_zero_overhead_noop(monkeypatch):
+    from tendermint_tpu import chaos
+    monkeypatch.delenv("TM_TPU_CHAOS", raising=False)
+    chaos.configure("off", 0)
+    link = object()
+    assert chaos.maybe_wrap_link(link, "peer") is link  # same object
+
+    monkeypatch.setenv("TM_TPU_CHAOS", "drop=0.5,seed=3")
+    wrapped = chaos.maybe_wrap_link(link, "peer")
+    assert wrapped is not link
+    from tendermint_tpu.p2p.fuzz import FuzzedLink
+    assert isinstance(wrapped, FuzzedLink)
+
+    # env wins over configure(); off in env beats a configured spec
+    chaos.configure("drop=0.5", 1)
+    monkeypatch.setenv("TM_TPU_CHAOS", "off")
+    assert chaos.maybe_wrap_link(link, "peer") is link
+
+
+def test_spec_string_parse_rejects_typos():
+    from tendermint_tpu import chaos
+    assert chaos.parse_spec("drop=0.1,delay=0.2,delay_ms=25,seed=9") == {
+        "drop": 0.1, "delay": 0.2, "delay_ms": 25.0, "seed": 9}
+    with pytest.raises(ValueError, match="unknown chaos spec key"):
+        chaos.parse_spec("dorp=0.1")
+
+
+# ---------------------------------------------------------- monitor --
+
+def _fake_block(height, tag=b"A", evidence=()):
+    blk = types.SimpleNamespace()
+    blk.header = types.SimpleNamespace(height=height)
+    blk.evidence = types.SimpleNamespace(evidence=list(evidence))
+    blk.hash = lambda: tag * 32
+    return blk
+
+
+def test_monitor_detects_disagreement():
+    m = InvariantMonitor()
+    m._on_commit(1, 0, _fake_block(3, b"A"))
+    m._on_commit(2, 1, _fake_block(3, b"B"))  # different block, same h
+    assert [v["invariant"] for v in m.violations] == ["agreement"]
+
+
+def test_monitor_detects_height_regression():
+    m = InvariantMonitor()
+    m._on_commit(1, 0, _fake_block(3, b"A"))
+    m._on_commit(2, 0, _fake_block(3, b"A"))  # same node re-commits 3
+    assert [v["invariant"] for v in m.violations] == ["validity"]
+
+
+def test_monitor_flags_missing_evidence_and_liveness():
+    m = InvariantMonitor()
+    m.expect_double_sign(("ab", 2, 0, 1))
+    m._on_commit(5, 0, _fake_block(2))
+    sched = FaultSchedule({"partitions": [
+        {"start": 1, "stop": 10, "groups": [[0], [1]]}]})
+    rep = m.finalize(sched, final_step=400, liveness_bound=50)
+    kinds = sorted(v["invariant"] for v in rep["violations"])
+    # the double-sign never committed AND no commit followed the heal
+    assert kinds == ["evidence", "liveness"]
+
+
+# ------------------------------------------------------------ runs --
+
+def test_chaos_smoke_drop_delay_crash():
+    """Tier-1 seeded smoke (ISSUE 4 satellite): drop + delay + one
+    crash-restart through WAL/handshake replay, zero invariant
+    violations, all nodes caught up. Seconds on the 1-core host."""
+    from tendermint_tpu.chaos.runner import SMOKE_SPEC, run_chaos
+    r = run_chaos(spec=SMOKE_SPEC, seed=7, target_height=4,
+                  max_steps=400)
+    assert r["violations"] == []
+    assert r["max_height"] >= 4
+    assert set(r["heights"]) == {0, 1, 2, 3}
+    assert min(r["heights"].values()) >= 4
+    f = r["faults_injected"]
+    assert f.get("drop", 0) > 0 and f.get("delay", 0) > 0
+    assert f.get("crash") == 1 and f.get("restart") == 1
+    assert r["checks"]["agreement"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_acceptance_scenario():
+    """The BENCH_chaos.json scenario: drop/delay/duplicate/reorder,
+    partition + heal, crash-restart, equivocating validator, clock
+    skew — zero violations, every injected double-sign committed."""
+    from tendermint_tpu.chaos.runner import run_chaos
+    r = run_chaos(seed=42)
+    assert r["violations"] == []
+    f = r["faults_injected"]
+    for kind in ("drop", "delay", "duplicate", "reorder", "partition",
+                 "heal", "crash", "restart", "equivocation"):
+        assert f.get(kind, 0) >= 1, f"{kind} never fired: {f}"
+    ev = r["evidence"]
+    assert ev["injected_double_signs"] > 0
+    assert ev["committed"] == ev["injected_double_signs"]
+    assert r["recovery"]["latency_steps"]["n"] >= 3
+
+
+@pytest.mark.slow
+def test_chaos_partition_heals_and_recovers():
+    """Partition-only schedule: the majority side keeps committing, the
+    isolated node catches up after the heal (buffered delivery + the
+    runner's reactor-style catch-up), liveness check passes."""
+    from tendermint_tpu.chaos.runner import run_chaos
+    spec = {"partitions": [{"start": 20, "stop": 60,
+                            "groups": [[0], [1, 2, 3]]}]}
+    r = run_chaos(spec=spec, seed=5, target_height=8, max_steps=600)
+    assert r["violations"] == []
+    assert min(r["heights"].values()) >= 8
+    assert r["faults_injected"].get("partition") == 1
+    assert r["faults_injected"].get("heal") == 1
+
+
+def test_switch_links_get_chaos_wrapped_and_still_deliver(monkeypatch):
+    """TM_TPU_CHAOS on a real switch: both peers' links come back as
+    FuzzedLinks (per-frame fault injection live on the encrypted burst
+    path) and traffic still flows through a delay-only spec."""
+    from tests.test_p2p import (EchoReactor, connect_switches,
+                                make_switch, wait_for)
+    from tendermint_tpu.p2p.fuzz import FuzzedLink
+
+    monkeypatch.setenv("TM_TPU_CHAOS", "delay=0.3,delay_ms=5,seed=1")
+    r1 = EchoReactor("echo", 0x10, echo=False)
+    r2 = EchoReactor("echo", 0x10, echo=True)
+    sw1 = make_switch(seed=b"\x01" * 32, encrypt=True)
+    sw2 = make_switch(seed=b"\x02" * 32, encrypt=True)
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start()
+    sw2.start()
+    p1, p2 = connect_switches(sw1, sw2)
+    try:
+        assert isinstance(p1.mconn.link, FuzzedLink)
+        assert isinstance(p2.mconn.link, FuzzedLink)
+        assert p1.send(0x10, b"through-chaos")
+        assert wait_for(lambda: r2.received, timeout=5.0)
+        assert r2.received[0][1] == b"through-chaos"
+    finally:
+        sw1.stop()
+        sw2.stop()
+
+
+def test_violation_trace_is_written_and_replayable(tmp_path):
+    """A run asked for a trace dumps seed + spec + fault log + commits;
+    the trace's (spec, seed) rebuild an identical schedule."""
+    import json
+    from tendermint_tpu.chaos.runner import run_chaos
+    spec = {"drop": 0.05, "delay": 0.1}
+    trace = str(tmp_path / "trace.json")
+    r = run_chaos(spec=spec, seed=3, target_height=3, max_steps=300,
+                  trace_path=trace)
+    assert r["violations"] == []
+    doc = json.load(open(trace))
+    assert doc["seed"] == 3 and doc["spec"] == spec
+    assert doc["fault_log"]  # replayed decisions are all there
+    assert doc["commits"]

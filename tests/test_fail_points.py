@@ -1,0 +1,183 @@
+"""Fail-point plane tests: the stable commit-point catalog, the
+arm/clear test APIs, and the crash-at-every-index recovery sweep (the
+in-process equivalent of the reference's test_failure_indices.sh loop —
+kill one commit at EVERY commit-critical step, restart, and require WAL
++ handshake replay to reach the same AppHash a clean run reaches)."""
+
+import os
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.consensus import MockTicker
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import PrivValidatorFile
+from tendermint_tpu.utils import fail
+
+
+class _Crash(BaseException):
+    """Simulated process death (BaseException: nothing between the fail
+    point and the test may swallow it)."""
+
+
+def _gen(chain_id):
+    key = PrivKey.generate(b"\x0a" * 32)
+    gen = GenesisDoc(chain_id=chain_id, genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    return gen, key
+
+
+def _make_node(home, gen, key):
+    pv_path = os.path.join(home, "priv_validator.json")
+    if os.path.exists(pv_path):
+        pv = PrivValidatorFile.load(pv_path)
+    else:
+        pv = PrivValidatorFile(pv_path, key)
+        pv._persist()
+    node = Node(make_test_config(home), gen, priv_validator=pv,
+                app=KVStoreApp())
+    node.consensus.ticker.stop()
+    node.consensus.ticker = MockTicker(node.consensus._on_timeout_fire)
+    return node
+
+
+WAVE_A = [b"fp/a%d=v%d" % (i, i) for i in range(1, 4)]
+WAVE_B = [b"fp/b%d=w%d" % (i, i) for i in range(1, 4)]
+
+
+def _inject(node, txs):
+    """Dup-tolerant injection: after a restart the mempool WAL replays
+    pending txs, and committed ones may be re-proposed — KVStore sets
+    are idempotent, so the final app STATE converges either way."""
+    for tx in txs:
+        try:
+            node.mempool.check_tx(tx)
+        except Exception:
+            pass
+
+
+def _commit_to(node, target_height, max_ticks=400):
+    for _ in range(max_ticks):
+        if node.height >= target_height:
+            return
+        node.consensus.ticker.fire_next()
+    raise AssertionError(f"stuck at height {node.height}")
+
+
+def _drain(node, max_ticks=200):
+    """Commit until the mempool is empty: the final KV state is then
+    exactly the injected key set, comparable across runs."""
+    for _ in range(max_ticks):
+        if node.mempool.size() == 0:
+            return
+        node.consensus.ticker.fire_next()
+    raise AssertionError(f"mempool never drained ({node.mempool.size()})")
+
+
+# ---------------------------------------------------------- catalog --
+
+def test_commit_points_fire_in_catalog_order(tmp_path):
+    """One commit passes every COMMIT_POINTS entry, in order — the
+    catalog is what schedules and docs reference, so it must match the
+    code path exactly."""
+    seen = []
+    for name in fail.COMMIT_POINTS:
+        fail.arm(name, seen.append)
+    gen, key = _gen("fp-order")
+    node = _make_node(str(tmp_path), gen, key)
+    node.start()
+    _inject(node, WAVE_A)
+    _commit_to(node, 1)
+    node.stop()
+    assert seen == list(fail.COMMIT_POINTS)
+
+
+def test_set_target_and_callback_and_clear():
+    fail.reset()
+    hits = []
+    fail.set_callback(hits.append)
+    fail.set_target(2)
+    fail.fail_point("a")
+    fail.fail_point("b")
+    fail.fail_point("c")
+    assert hits == [2]  # only the target index fires
+    fail.clear_callback()
+    fail.set_target(None)
+    fail.reset()
+    fail.fail_point("d")  # no target: must be a no-op (not os._exit)
+
+
+def test_arm_is_one_shot_and_name_scoped():
+    fired = []
+    fail.arm("consensus.before_save_block", fired.append)
+    fail.fail_point("execution.after_save_state")   # other name: no-op
+    assert fired == []
+    fail.fail_point("consensus.before_save_block")
+    fail.fail_point("consensus.before_save_block")  # disarmed after one
+    assert fired == ["consensus.before_save_block"]
+
+
+# ------------------------------------------------ crash-index sweep --
+
+def test_crash_at_every_index_recovers_same_apphash(tmp_path):
+    """For EVERY commit-critical fail point: run two heights clean,
+    crash the third height's commit at that index, restart from disk,
+    and require the recovered node to reach the control run's height
+    with the IDENTICAL AppHash — WAL catchup + ABCI handshake replay
+    must reconcile whatever prefix of the commit reached disk."""
+    target = 4
+    gen, key = _gen("fp-sweep")
+
+    control = _make_node(str(tmp_path / "control"), gen, key)
+    control.start()
+    _inject(control, WAVE_A)
+    _commit_to(control, 2)
+    _inject(control, WAVE_B)
+    _commit_to(control, target)
+    _drain(control)
+    control_hash = control.consensus.state.app_hash
+    control.stop()
+    assert control_hash
+
+    for index in range(1, len(fail.COMMIT_POINTS) + 1):
+        home = str(tmp_path / f"crash{index}")
+        node = _make_node(home, gen, key)
+        node.start()
+        _inject(node, WAVE_A)
+        _commit_to(node, 2)
+
+        def crash(i):
+            raise _Crash(f"index {i}")
+
+        # armed BEFORE wave B: its injection may commit inline via the
+        # txs_available hook, and the first commit after arming is the
+        # one that must die at `index`
+        fail.reset()
+        fail.set_callback(crash)
+        fail.set_target(index)
+        with pytest.raises(_Crash):
+            _inject(node, WAVE_B)
+            _commit_to(node, target)
+        fail.set_target(None)
+        fail.clear_callback()
+        crashed_at = node.height
+        node.consensus._stopped = True
+        try:
+            node.stop()
+        except Exception:
+            pass
+
+        node2 = _make_node(home, gen, key)   # handshake replay here
+        node2.start()                        # WAL catchup replay here
+        assert node2.height >= crashed_at    # no committed height lost
+        _inject(node2, WAVE_B)
+        _commit_to(node2, target)
+        _drain(node2)
+        assert node2.consensus.state.app_hash == control_hash, (
+            f"index {index} ({fail.COMMIT_POINTS[index - 1]}): "
+            f"recovered AppHash diverged")
+        # the fresh app was really rebuilt from the stores, not trusted
+        assert node2.app.height == node2.block_store.height()
+        node2.stop()

@@ -1,0 +1,164 @@
+"""FuzzedLink regression tests for the vectored (burst) link API
+(ISSUE 4 satellite): per-frame fuzzing must apply on BOTH the scalar
+write/read path and the write_many/read_burst path — PR 3's burst-mode
+connections must not silently bypass fault injection — plus the
+deterministic decider the chaos plane drives links with."""
+
+import socket
+import threading
+
+import pytest
+
+from tendermint_tpu.p2p.conn.mconn import PlainFramedConn
+from tendermint_tpu.p2p.fuzz import FuzzConfig, FuzzedLink
+
+
+class _RecordingLink:
+    """Inner link double recording exactly which API got each frame."""
+
+    def __init__(self, bursts=()):
+        self.writes = []
+        self.write_manys = []
+        self._bursts = list(bursts)
+        self.closed = False
+
+    def write(self, data):
+        self.writes.append(bytes(data))
+        return len(data)
+
+    def write_many(self, chunks):
+        self.write_manys.append([bytes(c) for c in chunks])
+        return sum(len(c) for c in chunks)
+
+    def read(self):
+        burst = self.read_burst()
+        return burst[0] if burst else b""
+
+    def read_burst(self):
+        return self._bursts.pop(0) if self._bursts else []
+
+    def close(self):
+        self.closed = True
+
+
+class _ScalarOnlyLink(_RecordingLink):
+    """No vectored API: FuzzedLink must degrade to per-frame calls."""
+    write_many = None
+    read_burst = None
+
+    def __init__(self, frames=()):
+        super().__init__()
+        del self.write_manys
+        self._frames = list(frames)
+
+    def read(self):
+        return self._frames.pop(0) if self._frames else b""
+
+
+def _pattern_decider(pattern):
+    """Deterministic decider: one action per call, in order."""
+    it = iter(pattern)
+
+    def decide(op):
+        return next(it, None)
+
+    return decide
+
+
+def test_write_many_fuzzes_per_frame_and_keeps_burst():
+    inner = _RecordingLink()
+    link = FuzzedLink(inner, decider=_pattern_decider(
+        [None, "drop", None]))
+    n = link.write_many([b"aa", b"bb", b"cc"])
+    assert n == 6                       # caller sees full acceptance
+    assert inner.write_manys == [[b"aa", b"cc"]]  # one burst, survivor-only
+    assert inner.writes == []
+
+
+def test_write_many_falls_back_to_scalar_writes():
+    inner = _ScalarOnlyLink()
+    link = FuzzedLink(inner, decider=_pattern_decider([None, "drop"]))
+    assert link.write_many([b"xx", b"yy"]) == 4
+    assert inner.writes == [b"xx"]
+
+
+def test_read_burst_filters_frames_and_retries_until_survivor():
+    inner = _RecordingLink(bursts=[[b"p", b"q"], [b"r"], []])
+    # first burst entirely dropped -> must pull the next one
+    link = FuzzedLink(inner, decider=_pattern_decider(
+        ["drop", "drop", None]))
+    assert link.read_burst() == [b"r"]
+    assert link.read_burst() == []      # clean EOF propagates
+
+
+def test_read_burst_falls_back_to_scalar_read():
+    inner = _ScalarOnlyLink(frames=[b"one", b"two", b""])
+    link = FuzzedLink(inner, decider=_pattern_decider(
+        ["drop", None]))
+    assert link.read_burst() == [b"two"]
+    assert link.read_burst() == []
+
+
+def test_scalar_paths_still_fuzz():
+    inner = _RecordingLink(bursts=[[b"m1"], [b"m2"]])
+    link = FuzzedLink(inner, decider=_pattern_decider(
+        ["drop", None, "drop", None]))
+    assert link.write(b"w1") == 2       # dropped silently
+    assert link.write(b"w2") == 2       # delivered
+    assert inner.writes == [b"w2"]
+    assert link.read() == b"m2"         # m1 dropped, reads until one
+
+
+def test_on_fault_hook_counts_drops_and_delays():
+    faults = []
+    inner = _RecordingLink()
+    link = FuzzedLink(inner, decider=_pattern_decider(
+        ["drop", ("delay", 0.0), None]), on_fault=faults.append)
+    link.write(b"a")
+    link.write(b"b")
+    link.write(b"c")
+    assert faults == ["drop", "delay"]
+    assert inner.writes == [b"b", b"c"]
+
+
+def test_seeded_config_is_deterministic():
+    def run(seed):
+        inner = _RecordingLink()
+        link = FuzzedLink(inner, FuzzConfig(mode="drop",
+                                            prob_drop_rw=0.5, seed=seed))
+        for i in range(64):
+            link.write(bytes([i]))
+        return inner.writes
+
+    assert run(123) == run(123)
+    assert run(123) != run(321)
+
+
+def test_burst_and_scalar_paths_interop_over_sockets():
+    """End-to-end both paths (the satellite's regression): frames sent
+    through a fuzzed burst write arrive through a fuzzed burst read —
+    and the same wire works per-frame — with fault injection live on
+    every frame either way."""
+    for vectored in (True, False):
+        s1, s2 = socket.socketpair()
+        drops = iter([True, False, False, False])
+        tx = FuzzedLink(PlainFramedConn(s1),
+                        decider=lambda op: "drop" if next(drops, False)
+                        else None)
+        rx = FuzzedLink(PlainFramedConn(s2), decider=lambda op: None)
+        try:
+            frames = [b"f1", b"f2", b"f3", b"f4"]
+            if vectored:
+                tx.write_many(frames)
+            else:
+                for f in frames:
+                    tx.write(f)
+            got = []
+            while len(got) < 3:
+                burst = rx.read_burst() if vectored else [rx.read()]
+                assert burst, "EOF before surviving frames arrived"
+                got.extend(burst)
+            assert got == [b"f2", b"f3", b"f4"]  # f1 dropped pre-wire
+        finally:
+            tx.close()
+            rx.close()
