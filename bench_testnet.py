@@ -23,6 +23,8 @@ from bench_util import enable_tpu_compilation_cache
 
 enable_tpu_compilation_cache()  # must precede any jax import
 
+from tendermint_tpu.utils import knobs  # noqa: E402 (post-cache-setup)
+
 
 class _BenchMempool:
     """Endless reap: always has the next block's txs ready."""
@@ -251,7 +253,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                  "--max-seconds", "600"],
                 env=env, stdout=log, stderr=subprocess.STDOUT))
 
-        from tendermint_tpu.rpc.client import JSONRPCClient
+        from tendermint_tpu.rpc.client import (JSONRPCClient,
+                                               RPCClientError)
         clients = [JSONRPCClient(f"http://127.0.0.1:{base + 2 * i + 1}")
                    for i in range(n_vals)]
         deadline = time.monotonic() + 120
@@ -260,8 +263,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 if all(c.call("status")["latest_block_height"] >= 2
                        for c in clients):
                     break
-            except Exception:
-                pass
+            except (OSError, RPCClientError):
+                pass  # still booting; the liveness check below decides
             if any(p.poll() is not None for p in procs):
                 raise RuntimeError("socket-testnet node died during boot")
             time.sleep(0.5)
@@ -295,8 +298,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                     if ws is not None:
                         try:
                             ws.close()
-                        except Exception:
-                            pass
+                        except OSError:
+                            pass  # already torn down server-side
                         ws = None
                     time.sleep(0.2)
 
@@ -322,8 +325,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 if clients[0].call("num_unconfirmed_txs")[
                         "n_txs"] >= 2500:
                     break
-            except Exception:
-                pass
+            except (OSError, RPCClientError):
+                pass  # node busy/restarting; check_alive decides
             time.sleep(1.0)
 
         h0 = clients[0].call("status")["latest_block_height"]
@@ -340,8 +343,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         except Exception:
             p2p_metrics = {}
         chaos_metrics = {}
-        if chaos or os.environ.get("TM_TPU_CHAOS", "").strip() not in \
-                ("", "off"):
+        if chaos or (knobs.knob_raw("TM_TPU_CHAOS") or "off") \
+                .lower() not in knobs.FALSY:
             try:
                 chaos_metrics = _scrape_chaos_metrics(clients[0])
             except Exception:

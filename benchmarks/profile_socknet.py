@@ -64,7 +64,8 @@ def main():
                  "--max-seconds", "600"],
                 env=penv, stdout=log, stderr=subprocess.STDOUT))
 
-        from tendermint_tpu.rpc.client import JSONRPCClient, WSClient
+        from tendermint_tpu.rpc.client import (JSONRPCClient,
+                                               RPCClientError, WSClient)
         clients = [JSONRPCClient(f"http://127.0.0.1:{base + 2 * i + 1}")
                    for i in range(n_vals)]
         deadline = time.monotonic() + 120
@@ -73,8 +74,8 @@ def main():
                 if all(c.call("status")["latest_block_height"] >= 2
                        for c in clients):
                     break
-            except Exception:
-                pass
+            except (OSError, RPCClientError):
+                pass  # still booting; the deadline else-clause decides
             time.sleep(0.5)
         else:
             raise RuntimeError("no progress")

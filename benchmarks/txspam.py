@@ -21,7 +21,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
-    from tendermint_tpu.rpc.client import JSONRPCClient, WSClient
+    from tendermint_tpu.rpc.client import (JSONRPCClient,
+                                           RPCClientError, WSClient)
 
     addr = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1:46657"
     addr = addr.replace("ws://", "").replace("tcp://", "").split("/")[0]
@@ -42,8 +43,8 @@ def main() -> int:
             res = ws.call("broadcast_tx_async", tx=tx.hex())
             if res.get("code", 0) == 0:
                 accepted += 1
-        except Exception:
-            break
+        except (OSError, RPCClientError):
+            break  # server gone / spam window over
     dt = time.perf_counter() - t0
     ws.close()
 

@@ -1,104 +1,32 @@
 #!/usr/bin/env python
-"""Metric-catalog lint (run in the tier-1 flow and by test_telemetry).
+"""Metric-catalog lint — thin shim over the tmlint metrics checker.
 
-Imports every instrumented module so each registers its families into
-the process-wide registry, then fails on:
-
-  - duplicate FULL names after namespacing (a histogram `x` and a
-    counter `x_bucket` would collide in exposition)
-  - un-namespaced names: every metric must lead with a known subsystem
-    prefix (`verifier_`, `consensus_`, ...) so dashboards can group
-  - convention breaks: counters must end in `_total`; `_seconds` /
-    `_bytes` metrics must be histograms or gauges
-  - an exposition that fails its own line grammar
-
-Exit 0 + "OK" when clean; 1 with one line per violation otherwise.
+The real rules live in tendermint_tpu/analysis/checkers/metrics.py
+(run by scripts/lint.py and tier-1 via tests/test_lint.py); this entry
+point is kept because test_telemetry and operator muscle memory invoke
+it directly. Exit 0 + "OK" when clean; 1 with one line per violation.
 """
 
 import os
-import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Every subsystem that registers metrics must appear here — a new
-# instrumented module extends this set alongside docs/observability.md.
-KNOWN_SUBSYSTEMS = {
-    "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
-    "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
-    "chaos",
-}
-
-INSTRUMENTED_MODULES = [
-    "tendermint_tpu.models.verifier",
-    "tendermint_tpu.models.coalescer",
-    "tendermint_tpu.ops.merkle",
-    "tendermint_tpu.consensus.state",
-    "tendermint_tpu.mempool.mempool",
-    "tendermint_tpu.blockchain.pool",
-    "tendermint_tpu.p2p.switch",
-    "tendermint_tpu.p2p.conn.secret",    # tm_p2p_seal/open_seconds
-    "tendermint_tpu.p2p.conn.mconn",     # tm_p2p_frames_per_burst
-    "tendermint_tpu.types.events",       # tm_event_dropped_total
-    "tendermint_tpu.rpc.core",
-    "tendermint_tpu.chaos",              # tm_chaos_* fault/invariant plane
-]
-
-_LINE_RE = re.compile(
-    r'^[a-z_][a-z0-9_]*(\{[a-z0-9_]+="(?:[^"\\]|\\.)*"'
-    r'(,[a-z0-9_]+="(?:[^"\\]|\\.)*")*\})? -?[0-9.e+Inf-]+$')
+from tendermint_tpu.analysis.checkers.metrics import (  # noqa: E402,F401
+    INSTRUMENTED_MODULES,
+    KNOWN_SUBSYSTEMS,
+)
 
 
 def main() -> int:
-    import importlib
-    for mod in INSTRUMENTED_MODULES:
-        importlib.import_module(mod)
-    from tendermint_tpu import telemetry
-
-    problems = []
-    names = telemetry.REGISTRY.names()
-    if not names:
-        problems.append("registry is empty — instrumented modules "
-                        "registered nothing")
-
-    # subsystem prefixes + kind conventions
-    exposed = set()
-    for name in names:
-        fam = telemetry.REGISTRY.get(name)
-        subsystem = name.split("_", 1)[0]
-        if subsystem not in KNOWN_SUBSYSTEMS or "_" not in name:
-            problems.append(
-                f"{name}: not namespaced by a known subsystem "
-                f"(known: {sorted(KNOWN_SUBSYSTEMS)})")
-        if fam.kind == "counter" and not name.endswith("_total"):
-            problems.append(f"{name}: counters must end in _total")
-        if fam.kind == "counter" and (
-                name.endswith("_seconds") or name.endswith("_bytes")):
-            problems.append(f"{name}: unit-suffixed metrics must be "
-                            f"histograms or gauges")
-        # exposition-level collisions (histogram series suffixes)
-        series = {name}
-        if fam.kind == "histogram":
-            series = {name + s for s in ("_bucket", "_sum", "_count")}
-        clash = series & exposed
-        if clash:
-            problems.append(f"{name}: exposition series collide: {clash}")
-        exposed |= series
-
-    # the exposition must parse line by line
-    for line in telemetry.expose().splitlines():
-        if not line or line.startswith("#"):
-            continue
-        if not _LINE_RE.match(line):
-            problems.append(f"unparseable exposition line: {line!r}")
-
-    if problems:
-        for p in problems:
-            print(f"check_metrics: {p}")
+    from tendermint_tpu.analysis.checkers import metrics
+    findings = metrics.run()
+    if findings:
+        for f in findings:
+            print(f"check_metrics: {f.message}")
         return 1
-    print(f"check_metrics: OK ({len(names)} families, "
-          f"{len(exposed)} exposed series names)")
+    print(f"check_metrics: OK ({metrics.run.summary})")
     return 0
 
 

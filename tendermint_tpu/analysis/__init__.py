@@ -1,0 +1,39 @@
+"""tmlint — AST-driven invariant analysis for the TPU-BFT tree.
+
+Four PRs in, the codebase's hardest rules — consensus determinism, lock
+discipline across ~54 Lock/RLock/Condition sites, the "every TM_TPU_*
+knob is cataloged, env wins over config" convention — were enforced by
+reviewer memory; PR 2 shipped a stats race and PR 3 a two-reader
+nonce-interleave race a checker would have flagged. This package turns
+those prose invariants into machine-checked ones:
+
+  analysis.engine    one AST walk per file, checkers subscribe to node
+                     events; findings carry file:line + checker id and
+                     honor `# tmlint: allow(<id>): why` pragmas.
+  analysis.checkers  determinism, lock-discipline, knob-registry,
+                     exception-hygiene (AST) + metrics (registry lint,
+                     the old scripts/check_metrics.py).
+  analysis.lockwatch the runtime complement: TM_TPU_LOCKCHECK=on wraps
+                     threading locks, records the per-thread
+                     acquisition graph, reports ABBA cycles and
+                     cross-thread unguarded-attribute touches.
+
+`scripts/lint.py` runs everything and is wired into tier-1 via
+tests/test_lint.py, so the tree stays at zero findings. docs/
+static-analysis.md is the checker catalog and how-to-extend guide.
+"""
+
+from tendermint_tpu.analysis.engine import (  # noqa: F401
+    Checker,
+    Engine,
+    Finding,
+    Pragma,
+)
+
+
+def run_tree(root: str = ".", paths=None):
+    """Convenience: engine with every AST checker over the default scan
+    set. Returns (findings, pragmas, n_files)."""
+    from tendermint_tpu.analysis.checkers import all_checkers
+    eng = Engine(all_checkers(), root=root)
+    return eng.run(paths)

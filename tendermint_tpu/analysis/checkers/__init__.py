@@ -1,0 +1,25 @@
+"""The tmlint checker catalog (docs/static-analysis.md documents each).
+
+AST checkers run inside the engine's single tree walk; the metrics
+checker is a registry lint (it imports the instrumented modules) and is
+invoked separately by scripts/lint.py — `all_checkers()` returns only
+the AST ones so `analysis.run_tree` stays import-light.
+"""
+
+from tendermint_tpu.analysis.checkers.determinism import (  # noqa: F401
+    DeterminismChecker,
+)
+from tendermint_tpu.analysis.checkers.exceptions import (  # noqa: F401
+    ExceptionHygieneChecker,
+)
+from tendermint_tpu.analysis.checkers.knobs import (  # noqa: F401
+    KnobRegistryChecker,
+)
+from tendermint_tpu.analysis.checkers.locks import (  # noqa: F401
+    LockDisciplineChecker,
+)
+
+
+def all_checkers():
+    return [DeterminismChecker(), LockDisciplineChecker(),
+            KnobRegistryChecker(), ExceptionHygieneChecker()]

@@ -1,0 +1,106 @@
+"""metrics — the metric-catalog lint (ex scripts/check_metrics.py).
+
+Not an AST checker: it imports every instrumented module so each
+registers its families into the process-wide registry, then validates
+the catalog and the exposition. scripts/check_metrics.py is now a thin
+shim over `run()`; scripts/lint.py includes it unless --no-metrics.
+
+Rules (unchanged from the PR-1 lint):
+- no duplicate FULL names after namespacing (a histogram `x` and a
+  counter `x_bucket` would collide in exposition)
+- every metric leads with a known subsystem prefix so dashboards group
+- counters end in `_total`; `_seconds`/`_bytes` metrics are histograms
+  or gauges
+- the exposition parses line by line
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tendermint_tpu.analysis.engine import Finding
+
+CHECKER_ID = "metrics"
+
+# Every subsystem that registers metrics must appear here — a new
+# instrumented module extends this set alongside docs/observability.md.
+KNOWN_SUBSYSTEMS = {
+    "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
+    "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
+    "chaos",
+}
+
+INSTRUMENTED_MODULES = [
+    "tendermint_tpu.models.verifier",
+    "tendermint_tpu.models.coalescer",
+    "tendermint_tpu.ops.merkle",
+    "tendermint_tpu.consensus.state",
+    "tendermint_tpu.mempool.mempool",
+    "tendermint_tpu.blockchain.pool",
+    "tendermint_tpu.p2p.switch",
+    "tendermint_tpu.p2p.conn.secret",    # tm_p2p_seal/open_seconds
+    "tendermint_tpu.p2p.conn.mconn",     # tm_p2p_frames_per_burst
+    "tendermint_tpu.types.events",       # tm_event_dropped_total
+    "tendermint_tpu.rpc.core",
+    "tendermint_tpu.chaos",              # tm_chaos_* fault/invariant plane
+]
+
+_LINE_RE = re.compile(
+    r'^[a-z_][a-z0-9_]*(\{[a-z0-9_]+="(?:[^"\\]|\\.)*"'
+    r'(,[a-z0-9_]+="(?:[^"\\]|\\.)*")*\})? -?[0-9.e+Inf-]+$')
+
+_CATALOG = "tendermint_tpu/analysis/checkers/metrics.py"
+
+
+def run() -> List[Finding]:
+    """Import the instrumented modules and lint the registry. Findings
+    carry the catalog path (the registry has no single source line)."""
+    import importlib
+    for mod in INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+    from tendermint_tpu import telemetry
+
+    findings: List[Finding] = []
+
+    def problem(msg: str) -> None:
+        findings.append(Finding(CHECKER_ID, _CATALOG, 0, msg))
+
+    names = telemetry.REGISTRY.names()
+    if not names:
+        problem("registry is empty — instrumented modules registered "
+                "nothing")
+
+    exposed = set()
+    for name in names:
+        fam = telemetry.REGISTRY.get(name)
+        subsystem = name.split("_", 1)[0]
+        if subsystem not in KNOWN_SUBSYSTEMS or "_" not in name:
+            problem(f"{name}: not namespaced by a known subsystem "
+                    f"(known: {sorted(KNOWN_SUBSYSTEMS)})")
+        if fam.kind == "counter" and not name.endswith("_total"):
+            problem(f"{name}: counters must end in _total")
+        if fam.kind == "counter" and (
+                name.endswith("_seconds") or name.endswith("_bytes")):
+            problem(f"{name}: unit-suffixed metrics must be "
+                    f"histograms or gauges")
+        series = {name}
+        if fam.kind == "histogram":
+            series = {name + s for s in ("_bucket", "_sum", "_count")}
+        clash = series & exposed
+        if clash:
+            problem(f"{name}: exposition series collide: {clash}")
+        exposed |= series
+
+    for line in telemetry.expose().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if not _LINE_RE.match(line):
+            problem(f"unparseable exposition line: {line!r}")
+
+    run.summary = (f"{len(names)} families, {len(exposed)} "
+                   f"exposed series names")
+    return findings
+
+
+run.summary = ""

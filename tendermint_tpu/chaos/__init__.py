@@ -35,12 +35,12 @@ below is for the in-process runner):
 
 from __future__ import annotations
 
-import os
 import random
 import zlib
 from typing import Optional
 
 from tendermint_tpu import telemetry
+from tendermint_tpu.utils import knobs
 
 # -- telemetry (registered at import; recorded only while enabled) ---------
 
@@ -99,10 +99,10 @@ def resolve() -> tuple[bool, dict, int]:
     configured mode; 'off'/'' disables. Read per call so subprocess
     harnesses (bench_testnet.run_socket) flip it via child env."""
     mode = _cfg_mode
-    env = os.environ.get("TM_TPU_CHAOS", "").strip()
+    env = knobs.knob_spec("TM_TPU_CHAOS")
     if env:
         mode = env
-    if mode.lower() in ("", "off", "0", "false", "no", "disabled"):
+    if not mode or mode.lower() in knobs.FALSY:
         return False, {}, 0
     spec = parse_spec(mode) if "=" in mode else {}
     seed = spec.pop("seed", _cfg_seed)

@@ -56,6 +56,7 @@ class InvariantMonitor:
         self.expected_double_signs: set = set()
         self.committed_evidence: set = set()
         self.violations: List[dict] = []
+        self.notes: List[dict] = []
         self.checks: Dict[str, int] = {}
         self.max_height = 0
 
@@ -83,6 +84,11 @@ class InvariantMonitor:
         self.violations.append(
             {"invariant": invariant, "step": step, **detail})
         chaos.VIOLATIONS.labels(invariant).inc()
+
+    def note(self, kind: str, msg: str) -> None:
+        """Non-violation observation (teardown hiccups, oddities) —
+        recorded in the report, never affects the verdict."""
+        self.notes.append({"kind": kind, "msg": msg})
 
     def expect_double_sign(self, key: tuple) -> None:
         self.expected_double_signs.add(key)
@@ -169,6 +175,7 @@ class InvariantMonitor:
             "checks": dict(self.checks),
             "checks_total": sum(self.checks.values()),
             "violations": list(self.violations),
+            "notes": list(self.notes),
             "heights": dict(self.node_height),
             "max_height": self.max_height,
             "evidence": {

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional
 from tendermint_tpu.chaos.byzantine import ByzantineAgent, forget_locks
 from tendermint_tpu.chaos.monitor import InvariantMonitor
 from tendermint_tpu.chaos.schedule import FaultSchedule
+from tendermint_tpu.mempool import MempoolFull, TxAlreadyInCache
 from tendermint_tpu.utils import fail
 
 RELAYED = ("proposal", "block_part", "vote")
@@ -161,8 +162,10 @@ class ChaosNet:
             if node is not None:
                 try:
                     node.stop()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # teardown must not mask the run's verdict, but a
+                    # node that cannot stop cleanly is worth seeing
+                    self.monitor.note("teardown", f"node {i} stop: {e!r}")
             self.nodes[i] = None
 
     # ------------------------------------------------------------- interacting
@@ -243,7 +246,7 @@ class ChaosNet:
                     continue
                 try:
                     node.mempool.check_tx(tx)
-                except Exception:
+                except (TxAlreadyInCache, MempoolFull):
                     pass  # dup after restart replay / mempool full
 
         for i, node in enumerate(self.nodes):
@@ -413,11 +416,19 @@ def run_chaos(spec: Optional[dict] = None, seed: int = 42,
     spec = ACCEPTANCE_SPEC if spec is None else spec
     own_dir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="chaos-net-")
+    # TM_TPU_LOCKCHECK=on: ChaosNet doubles as a race harness — every
+    # lock the nodes allocate below joins the acquisition-order graph,
+    # and guarded attributes get runtime descriptors; the report gains
+    # a "lockwatch" section (cycles must be empty — tier-1 asserts it)
+    from tendermint_tpu.analysis import lockwatch
+    lockcheck = lockwatch.maybe_install()
     net = ChaosNet(workdir, spec, seed, n=n)
     try:
         net.start()
         net.run(target_height, max_steps=max_steps)
         report = net.report()
+        if lockcheck:
+            report["lockwatch"] = lockwatch.report()
         if report["violations"] or trace_path:
             # never inside a workdir this function is about to delete
             path = trace_path or os.path.join(

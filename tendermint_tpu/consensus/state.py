@@ -39,6 +39,7 @@ from tendermint_tpu.types.proposal import Heartbeat, Proposal
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote, VoteType
 from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSet
+from tendermint_tpu.utils import clock
 
 
 class ConsensusFailure(Exception):
@@ -129,7 +130,7 @@ class ConsensusState:
                         wal_obj = dict(m)
                         if p:
                             wal_obj["peer"] = p
-                        self.wal.save(wal_obj, time_ns=time.time_ns())
+                        self.wal.save(wal_obj, time_ns=clock.now_ns())
                     try:
                         self._handle(m, p)
                     except (ConsensusFailure, AssertionError,
@@ -255,7 +256,7 @@ class ConsensusState:
             rs.start_time_ns = rs.commit_time_ns + int(
                 self.config.commit_timeout_s() * 1e9)
         else:
-            rs.start_time_ns = time.time_ns() + int(
+            rs.start_time_ns = clock.now_ns() + int(
                 self.config.commit_timeout_s() * 1e9)
         rs.validators = state.validators
         rs.proposal = None
@@ -300,7 +301,7 @@ class ConsensusState:
                              if self.rs.last_commit else -1})
 
     def _schedule_round0(self) -> None:
-        sleep_s = max(0.0, (self.rs.start_time_ns - time.time_ns()) / 1e9)
+        sleep_s = max(0.0, (self.rs.start_time_ns - clock.now_ns()) / 1e9)
         self._schedule_timeout(sleep_s, self.rs.height, 0, Step.NEW_HEIGHT)
 
     def _schedule_timeout(self, duration_s: float, height: int, round_: int,
@@ -432,7 +433,7 @@ class ConsensusState:
         pol_round = pol.round if pol else -1
         pol_block_id = pol.block_id if pol else BlockID()
         proposal = Proposal(height, round_, parts.header(), pol_round,
-                            pol_block_id, timestamp_ns=time.time_ns())
+                            pol_block_id, timestamp_ns=clock.now_ns())
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
         except Exception as e:
@@ -468,7 +469,7 @@ class ConsensusState:
         txs = self.mempool.reap(self.config.max_block_size_txs)
         evidence = self.evidence_pool.pending_evidence()
         block = self.state.make_block(rs.height, txs, commit,
-                                      time_ns=time.time_ns(),
+                                      time_ns=clock.now_ns(),
                                       evidence=evidence)
         parts = block.make_part_set(
             self.state.consensus_params.block_gossip.block_part_size_bytes)
@@ -633,7 +634,7 @@ class ConsensusState:
 
         rs.step = Step.COMMIT
         rs.commit_round = commit_round
-        rs.commit_time_ns = time.time_ns()
+        rs.commit_time_ns = clock.now_ns()
         if telemetry.enabled() and self._round_t0 and not self.replay_mode:
             _m_round_dur.observe(time.perf_counter() - self._round_t0)
         self._new_step()
@@ -880,7 +881,7 @@ class ConsensusState:
         if idx < 0:
             return
         vote = Vote(addr, idx, rs.height, rs.round,
-                    time.time_ns(), type_, BlockID(hash_, parts_header))
+                    clock.now_ns(), type_, BlockID(hash_, parts_header))
         try:
             self.priv_validator.sign_vote(self.state.chain_id, vote)
         except Exception as e:

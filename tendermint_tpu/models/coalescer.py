@@ -162,19 +162,19 @@ class DispatchCoalescer:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self._cond = threading.Condition()
-        self._queue: list[_Call] = []
-        self._closed = False
+        self._queue: list[_Call] = []      #: guarded_by _cond
+        self._closed = False               #: guarded_by _cond
         # EWMA inter-arrival gap, seeded sparse (= no lingering) so the
         # first calls after startup never pay the window
-        self._ewma_gap = max(max_wait_s, 1e-4)
-        self._last_arrival = 0.0
+        self._ewma_gap = max(max_wait_s, 1e-4)  #: guarded_by _cond
+        self._last_arrival = 0.0           #: guarded_by _cond
         # the dispatcher thread is LAZY and self-reaping: spawned on the
         # first submit, exits after idle_timeout_s without traffic (and
         # respawns on the next submit) — so short-lived verifiers don't
         # accumulate parked threads for the process lifetime
         self.idle_timeout_s = 30.0
-        self._running = False
-        self._thread = None
+        self._running = False              #: guarded_by _cond
+        self._thread = None                #: guarded_by _cond
 
     # ------------------------------------------------------------ callers
 
@@ -213,7 +213,7 @@ class DispatchCoalescer:
 
     # --------------------------------------------------------- dispatcher
 
-    def _window_s(self) -> float:
+    def _window_s_locked(self) -> float:
         """Linger budget for the current drain: ~4 inter-arrival gaps
         when traffic is dense enough that more arrivals are imminent,
         zero when the EWMA gap says waiting can't coalesce anything."""
@@ -246,7 +246,7 @@ class DispatchCoalescer:
                 # gaps without a new arrival, hard cap max_wait from
                 # the first drain, early out at max_batch
                 hard = t0 + self.max_wait_s
-                deadline = t0 + self._window_s()
+                deadline = t0 + self._window_s_locked()
                 while not self._closed and n < self.max_batch:
                     now = time.perf_counter()
                     if now >= deadline:
@@ -257,7 +257,7 @@ class DispatchCoalescer:
                         self._queue = []
                         n = sum(c.n for c in calls)
                         deadline = min(
-                            hard, time.perf_counter() + self._window_s())
+                            hard, time.perf_counter() + self._window_s_locked())
             self._dispatch_merged(calls)
 
     def _dispatch_merged(self, calls: list) -> None:

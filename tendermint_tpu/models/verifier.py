@@ -33,7 +33,6 @@ still be injected via `kernel=` (tests, bespoke meshes).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, Sequence
@@ -41,6 +40,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from tendermint_tpu import telemetry
+from tendermint_tpu.utils import knobs
 
 # The paper's headline metric is sig-verifies/sec/chip; these families
 # record exactly what that decomposes into: how big the batches arriving
@@ -96,8 +96,8 @@ def _fetch_pool_get():
             # 8-worker rate (tunnel sweep, 2026-08-01). Threads are
             # idle-cheap; TM_TPU_FETCH_WORKERS overrides.
             _fetch_pool = ThreadPoolExecutor(
-                max_workers=int(os.environ.get(
-                    "TM_TPU_FETCH_WORKERS", "8")),
+                max_workers=knobs.knob_int("TM_TPU_FETCH_WORKERS",
+                                           default=8),
                 thread_name_prefix="tm-verify-fetch")
         return _fetch_pool
 
@@ -162,8 +162,8 @@ class BatchVerifier:
         # TM_TPU_AUTO_THRESHOLD. Bulk paths (fast-sync windows, lite
         # chains, 1000+-validator commits) sit far above any setting.
         if auto_threshold is None:
-            auto_threshold = int(os.environ.get(
-                "TM_TPU_AUTO_THRESHOLD", "128"))
+            auto_threshold = knobs.knob_int("TM_TPU_AUTO_THRESHOLD",
+                                            default=128)
         # eager, loud validation — this is fed by config/env text, and a
         # typo must fail at startup (asserts vanish under python -O)
         if backend not in ("auto", "jax", "python"):
@@ -184,24 +184,25 @@ class BatchVerifier:
         # reactor/RPC thread concurrently — one lock, held for dict
         # arithmetic only (never across a dispatch)
         self._stats_lock = threading.Lock()
+        #: guarded_by _stats_lock
         self.stats = {"calls": 0, "sigs": 0, "jax_sigs": 0,
                       "coalesced_calls": 0}
         # cross-call dispatch coalescing (models/coalescer.py): merge
         # concurrent sub-threshold verify calls into one batch. Env
         # knobs win over constructor args (same contract as telemetry:
         # an operator's TM_TPU_COALESCE=off must silence any config).
-        env = os.environ.get("TM_TPU_COALESCE")
         self.coalesce = _parse_coalesce_spec(
-            env if env else ("auto" if coalesce is None else coalesce))
+            knobs.knob_str("TM_TPU_COALESCE", config=coalesce,
+                           default="auto"))
         if coalesce_wait_ms is None:
-            coalesce_wait_ms = float(os.environ.get(
-                "TM_TPU_COALESCE_WAIT_MS", "2.0"))
+            coalesce_wait_ms = knobs.knob_float(
+                "TM_TPU_COALESCE_WAIT_MS", default=2.0)
         self._coalesce_wait_s = coalesce_wait_ms / 1e3
         if coalesce_max_batch is None:
-            coalesce_max_batch = int(os.environ.get(
-                "TM_TPU_COALESCE_MAX_BATCH", "0"))
+            coalesce_max_batch = knobs.knob_int(
+                "TM_TPU_COALESCE_MAX_BATCH", default=0)
         self._coalesce_max_batch = coalesce_max_batch or BATCH_CHUNK
-        self._coalescer = None  # built on first qualifying submit
+        self._coalescer = None  #: guarded_by _resolve_lock
 
     def _resolve_mesh(self) -> None:
         """Build the sharded kernel on first device dispatch. mesh='auto'
@@ -257,6 +258,10 @@ class BatchVerifier:
         if self.coalesce != "off" and 0 < n <= self.auto_threshold:
             with self._stats_lock:
                 self.stats["coalesced_calls"] += 1
+            # double-checked fast path: the unlocked read sees None or
+            # a fully-built coalescer (assignment is atomic, publication
+            # happens under the lock); the slow path re-checks locked.
+            # tmlint: allow(lock-discipline): benign racy read, see above
             c = self._coalescer
             if c is None:
                 with self._resolve_lock:
@@ -482,8 +487,9 @@ def default_verifier() -> BatchVerifier:
     zero code changes)."""
     global _default
     if _default is None:
-        _default = BatchVerifier(os.environ.get("TM_TPU_VERIFIER", "auto"),
-                                 mesh=os.environ.get("TM_TPU_MESH", "auto"))
+        _default = BatchVerifier(
+            knobs.knob_str("TM_TPU_VERIFIER", default="auto"),
+            mesh=knobs.knob_str("TM_TPU_MESH", default="auto"))
     return _default
 
 

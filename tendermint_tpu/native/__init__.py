@@ -13,6 +13,8 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from tendermint_tpu.utils import knobs
 from typing import List, Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -46,7 +48,7 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("TM_TPU_NO_NATIVE"):
+        if knobs.knob_set("TM_TPU_NO_NATIVE"):
             return None
         path = _build()
         if path is None:
@@ -109,7 +111,7 @@ def codec():
         if _codec_tried:
             return _codec_mod
         _codec_tried = True
-        if os.environ.get("TM_TPU_NO_NATIVE"):
+        if knobs.knob_set("TM_TPU_NO_NATIVE"):
             return None
         _codec_mod = _load_ext("_tmcodec", _CODEC_SRC, _CODEC_LIB)
         return _codec_mod
@@ -177,7 +179,7 @@ def _prep():
         if _prep_tried:
             return _prep_mod
         _prep_tried = True
-        if os.environ.get("TM_TPU_NO_NATIVE"):
+        if knobs.knob_set("TM_TPU_NO_NATIVE"):
             return None
         # prep.cpp #includes hostops.cpp, so it depends on both sources
         _prep_mod = _load_ext("_tmprep", _PREP_SRC, _PREP_LIB, "-O3",
@@ -219,7 +221,7 @@ def kv():
         if _kv_tried:
             return _kv_mod
         _kv_tried = True
-        if os.environ.get("TM_TPU_NO_NATIVE"):
+        if knobs.knob_set("TM_TPU_NO_NATIVE"):
             return None
         _kv_mod = _load_ext("_tmkv", _KV_SRC, _KV_LIB, "-O3",
                             extra_deps=(_SRC,), std="c++20")

@@ -145,8 +145,8 @@ verify_kernel_jit = jax.jit(verify_kernel)
 
 def _pallas_available() -> bool:
     """The fused Mosaic kernel needs a real TPU backend."""
-    import os
-    if os.environ.get("TM_TPU_NO_PALLAS"):
+    from tendermint_tpu.utils import knobs
+    if knobs.knob_set("TM_TPU_NO_PALLAS"):
         return False
     try:
         return jax.devices()[0].platform == "tpu"

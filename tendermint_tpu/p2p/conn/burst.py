@@ -22,8 +22,9 @@ burst and non-burst nodes interoperate frame-for-frame.
 
 from __future__ import annotations
 
-import os
 from typing import Tuple
+
+from tendermint_tpu.utils import knobs
 
 DEFAULT_MAX_PACKETS = 64  # ~64KB ceiling per sendall at 1KB frames
 
@@ -43,12 +44,12 @@ def resolve() -> Tuple[bool, int]:
     every call so tests and subprocess harnesses can flip it without
     re-importing; connection setup calls this once per MConnection."""
     mode, max_packets = _cfg_mode, _cfg_max
-    env = os.environ.get("TM_TPU_P2P_BURST", "").strip().lower()
+    env = knobs.knob_str("TM_TPU_P2P_BURST")
     if env:
         if env.isdigit():
             mode, max_packets = "on", max(1, int(env))
         else:
             mode = env
-    if mode in ("off", "0", "false", "no", "disabled"):
+    if mode in knobs.FALSY:
         return False, 1
     return True, max(1, max_packets)

@@ -101,10 +101,10 @@ class MConnection:
         self.ping_interval = ping_interval
         self.idle_timeout = idle_timeout
         self._cond = threading.Condition()
-        self._pong_due = 0
-        self._stopped = False
-        self._errored = False
-        self._last_recv = time.monotonic()
+        self._pong_due = 0                    #: guarded_by _cond
+        self._stopped = False                 #: guarded_by _cond
+        self._errored = False                 #: guarded_by _cond
+        self._last_recv = time.monotonic()    #: guarded_by _cond
         self._threads: List[threading.Thread] = []
         # burst frame plane (ISSUE 3): coalesce up to _burst_max packets
         # per link write (one AEAD burst + one sendall on a
@@ -148,7 +148,8 @@ class MConnection:
 
     @property
     def running(self) -> bool:
-        return not self._stopped
+        with self._cond:
+            return not self._stopped
 
     def _error(self, e: Exception) -> None:
         with self._cond:
@@ -165,10 +166,12 @@ class MConnection:
         """Queue a full message; blocks while the channel queue is full
         (connection.go:249). False if unknown channel/timeout/stopped."""
         ch = self.channels.get(ch_id)
-        if ch is None or self._stopped:
+        if ch is None:
             return False
         deadline = time.monotonic() + timeout
         with self._cond:
+            if self._stopped:
+                return False
             while len(ch.queue) >= ch.desc.send_queue_capacity:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._stopped:
@@ -183,10 +186,11 @@ class MConnection:
     def try_send(self, ch_id: int, msg: bytes) -> bool:
         """Non-blocking send (connection.go:278)."""
         ch = self.channels.get(ch_id)
-        if ch is None or self._stopped:
+        if ch is None:
             return False
         with self._cond:
-            if len(ch.queue) >= ch.desc.send_queue_capacity:
+            if self._stopped or \
+                    len(ch.queue) >= ch.desc.send_queue_capacity:
                 return False
             ch.queue.append(bytes(msg))
             self._cond.notify_all()
@@ -279,8 +283,11 @@ class MConnection:
                     for packet in packets:
                         self.link.write(packet)
                         self.send_monitor.update(len(packet))
-                # idle/death detection
-                if now - self._last_recv > self.idle_timeout:
+                # idle/death detection (cross-thread read: the recv
+                # routine owns the write, both go through _cond)
+                with self._cond:
+                    last_recv = self._last_recv
+                if now - last_recv > self.idle_timeout:
                     raise ConnectionError(
                         f"no data for {self.idle_timeout}s (keepalive)")
         except Exception as e:
@@ -290,7 +297,7 @@ class MConnection:
 
     def _recv_routine(self) -> None:
         try:
-            while not self._stopped:
+            while self.running:
                 if self._burst_read:
                     # drain every frame the link already buffered: one
                     # AEAD open call for the burst, flowrate/keepalive
@@ -309,7 +316,8 @@ class MConnection:
                         raise ConnectionError("connection closed by peer")
                     self.recv_monitor.update(len(frame))
                     frames = (frame,)
-                self._last_recv = time.monotonic()
+                with self._cond:
+                    self._last_recv = time.monotonic()
                 for frame in frames:
                     self._handle_frame(frame)
         except Exception as e:
@@ -351,7 +359,7 @@ class PlainFramedConn:
         self.conn = conn
         self._lock = threading.Lock()
         self._rlock = threading.Lock()
-        self._rbuf = bytearray()
+        self._rbuf = bytearray()  #: guarded_by _rlock
 
     def write(self, data: bytes) -> int:
         with self._lock:
@@ -376,7 +384,7 @@ class PlainFramedConn:
         with self._rlock:
             return self._read_frames_locked(limit=0)
 
-    def _fill(self, need: int, allow_eof: bool = False) -> bool:
+    def _fill_locked(self, need: int, allow_eof: bool = False) -> bool:
         while len(self._rbuf) < need:
             chunk = self.conn.recv(65536)
             if not chunk:
@@ -387,7 +395,7 @@ class PlainFramedConn:
         return True
 
     def _read_frames_locked(self, limit: int = 0):
-        if not self._fill(4, allow_eof=True):
+        if not self._fill_locked(4, allow_eof=True):
             return []
         frames = []
         while len(self._rbuf) >= 4:
@@ -395,7 +403,7 @@ class PlainFramedConn:
             if len(self._rbuf) < 4 + n:
                 if frames:
                     break
-                self._fill(4 + n)
+                self._fill_locked(4 + n)
             frames.append(bytes(self._rbuf[4:4 + n]))
             del self._rbuf[:4 + n]
             if limit and len(frames) >= limit:

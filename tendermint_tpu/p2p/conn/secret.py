@@ -133,8 +133,8 @@ class SecretConnection:
     def __init__(self, conn, send_cipher: _Cipher, recv_cipher: _Cipher,
                  remote_pubkey: bytes = b""):
         self.conn = conn
-        self._send = send_cipher
-        self._recv = recv_cipher
+        self._send = send_cipher         #: guarded_by _send_lock
+        self._recv = recv_cipher         #: guarded_by _rlock
         self.remote_pubkey = remote_pubkey
         self._send_lock = threading.Lock()
         # recv-side lock mirroring the send lock: two concurrent read()
@@ -142,7 +142,7 @@ class SecretConnection:
         # takes nonce n, reader B nonce n+1, but B's frame arrives
         # first) and poison the stream with spurious InvalidTags.
         self._rlock = threading.Lock()
-        self._rbuf = bytearray()  # burst-mode socket read-ahead
+        self._rbuf = bytearray()  #: guarded_by _rlock (socket read-ahead)
         self._burst = burst_cfg.resolve()[0]
 
     # ------------------------------------------------------------- handshake
@@ -254,7 +254,7 @@ class SecretConnection:
         """One frame's plaintext (<=1024B). b'' on clean EOF."""
         with self._rlock:
             if not self._burst:
-                return self._read_frame_unbuffered()
+                return self._read_frame_unbuffered_locked()
             frames = self._read_frames_locked(limit=1)
             return frames[0] if frames else b""
 
@@ -265,13 +265,13 @@ class SecretConnection:
         receive-side batching decision, not a wire format."""
         with self._rlock:
             if not self._burst:
-                frame = self._read_frame_unbuffered()
+                frame = self._read_frame_unbuffered_locked()
                 return [frame] if frame != b"" else []
             return self._read_frames_locked(limit=0)
 
-    def _read_frame_unbuffered(self) -> bytes:
+    def _read_frame_unbuffered_locked(self) -> bytes:
         """The pre-burst read path (escape hatch): exact-size recvs,
-        one python AEAD open per frame."""
+        one python AEAD open per frame. Caller holds _rlock."""
         hdr = _read_exact(self.conn, 4, allow_eof=True)
         if hdr == b"":
             return b""
@@ -286,7 +286,7 @@ class SecretConnection:
             _m_opened.inc()
         return _strip_frame(plain)
 
-    def _fill(self, need: int, allow_eof: bool = False) -> bool:
+    def _fill_locked(self, need: int, allow_eof: bool = False) -> bool:
         """Grow the read-ahead buffer to >= need bytes. False on clean
         EOF (only when allow_eof and nothing is buffered)."""
         while len(self._rbuf) < need:
@@ -302,7 +302,7 @@ class SecretConnection:
         """Parse sealed frames out of the read-ahead buffer (blocking
         until the first is complete), open them in one burst, and return
         the payloads. limit=0 means every complete frame buffered."""
-        if not self._fill(4, allow_eof=True):
+        if not self._fill_locked(4, allow_eof=True):
             return []
         sealed: List[bytes] = []
         while len(self._rbuf) >= 4:
@@ -312,7 +312,7 @@ class SecretConnection:
             if len(self._rbuf) < 4 + clen:
                 if sealed:
                     break  # later frames: don't block mid-burst
-                self._fill(4 + clen)
+                self._fill_locked(4 + clen)
             sealed.append(bytes(self._rbuf[4:4 + clen]))
             del self._rbuf[:4 + clen]
             if limit and len(sealed) >= limit:

@@ -29,6 +29,8 @@ class PEXReactor(Reactor):
                  ensure_peers_period: float = DEFAULT_ENSURE_PEERS_PERIOD,
                  seed_mode: bool = False):
         super().__init__("pex")
+        from tendermint_tpu.utils.log import get_logger
+        self.logger = get_logger("pex")
         self.book = addr_book
         self.period = ensure_peers_period
         self.seed_mode = seed_mode
@@ -126,8 +128,8 @@ class PEXReactor(Reactor):
         while not self._stop.wait(self.period * (0.9 + 0.2 * random.random())):
             try:
                 self.ensure_peers()
-            except Exception:
-                pass
+            except Exception as e:
+                self.logger.error("ensure_peers failed", err=repr(e))
 
     def ensure_peers(self) -> None:
         """Dial toward WANT_OUTBOUND outbound peers (pex_reactor.go:107)."""
@@ -156,8 +158,9 @@ class PEXReactor(Reactor):
                 try:
                     self.switch.dial_peer(a)
                     self.book.mark_good(a)
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.logger.debug("pex dial failed", addr=str(a),
+                                      err=repr(e))
             threading.Thread(target=dial, daemon=True).start()
             need -= 1
             if need <= 0:

@@ -321,11 +321,13 @@ class Switch:
         peer.start()
         if self.trust_store is not None:
             self.trust_store.get_metric(peer.id).good_events(1)
-        for reactor in self.reactors.values():
+        for name, reactor in self.reactors.items():
             try:
                 reactor.add_peer(peer)
-            except Exception:
-                pass
+            except Exception as e:
+                self.logger.error("reactor add_peer failed",
+                                  reactor=name, peer=peer.id,
+                                  err=repr(e))
         return peer
 
     # --------------------------------------------------------------- routing
@@ -369,11 +371,13 @@ class Switch:
         self.peers.remove(peer)
         _m_peers.set(self.peers.size())
         peer.stop(join=join)
-        for reactor in self.reactors.values():
+        for name, reactor in self.reactors.items():
             try:
                 reactor.remove_peer(peer, reason)
-            except Exception:
-                pass
+            except Exception as e:
+                self.logger.error("reactor remove_peer failed",
+                                  reactor=name, peer=peer.id,
+                                  err=repr(e))
         if self.trust_store is not None:
             self.trust_store.peer_disconnected(peer.id)
 

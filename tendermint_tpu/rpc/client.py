@@ -295,8 +295,10 @@ class ReconnectingWSClient:
             if self.on_reconnect is not None:
                 try:
                     self.on_reconnect(self)
-                except Exception:
-                    pass
+                except Exception as e:
+                    from tendermint_tpu.utils.log import get_logger
+                    get_logger("rpc.client").error(
+                        "on_reconnect callback failed", err=repr(e))
 
     def call(self, method: str, timeout: float = 30.0, **params) -> Any:
         import time as _t

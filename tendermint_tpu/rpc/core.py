@@ -11,6 +11,7 @@ on config (dial_peers, flush_mempool)."""
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -496,7 +497,7 @@ class RPCCore:
             while ws.open and not sub.cancelled:
                 try:
                     item = sub.get(timeout=0.5)
-                except Exception:
+                except queue.Empty:
                     continue
                 try:
                     ws.send_json({"jsonrpc": "2.0", "id": "#event",

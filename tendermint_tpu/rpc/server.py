@@ -223,8 +223,10 @@ class WSConn:
         for cb in self.on_close:
             try:
                 cb(self)
-            except Exception:
-                pass
+            except Exception as e:
+                from tendermint_tpu.utils.log import get_logger
+                get_logger("rpc").error("ws on_close callback failed",
+                                        err=repr(e))
         try:
             # shutdown BEFORE close: the handler thread is blocked in
             # recv on this socket, which pins the fd — a bare close()

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import queue
 import threading
 from typing import Dict, List, Optional
 
@@ -173,7 +174,7 @@ class IndexerService:
         while not self._stop.is_set():
             try:
                 item = self.sub.get(timeout=0.5)
-            except Exception:
+            except queue.Empty:
                 continue
             d = item.data
             result = d["result"]

@@ -23,10 +23,11 @@ from __future__ import annotations
 
 import bisect
 import math
-import os
 import re
 import threading
 from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from tendermint_tpu.utils import knobs
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -45,11 +46,8 @@ RATIO_BUCKETS: Tuple[float, ...] = (
 
 def _env_enabled() -> Optional[bool]:
     """TM_TPU_TELEMETRY: unset -> None (config decides, default on);
-    off/0/false/no -> False; anything else -> True."""
-    v = os.environ.get("TM_TPU_TELEMETRY", "").strip().lower()
-    if not v:
-        return None
-    return v not in ("off", "0", "false", "no", "disabled")
+    FALSY values -> False; anything else -> True."""
+    return knobs.knob_flag3("TM_TPU_TELEMETRY")
 
 
 class _TelemetryState:

@@ -4,12 +4,12 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.utils import clock
 
 
 @dataclass
@@ -46,7 +46,7 @@ class GenesisDoc:
             if v.power <= 0:
                 raise ValueError("genesis validator power must be positive")
         if self.genesis_time_ns == 0:
-            self.genesis_time_ns = time.time_ns()
+            self.genesis_time_ns = clock.now_ns()
 
     def validator_hash(self) -> bytes:
         from tendermint_tpu.types.validator_set import Validator, ValidatorSet

@@ -15,6 +15,7 @@ import os
 from dataclasses import dataclass
 
 from tendermint_tpu.utils import ed25519_ref as _ref
+from tendermint_tpu.utils import knobs
 
 
 def address_of(pubkey: bytes) -> bytes:
@@ -296,7 +297,7 @@ def _openssl_available() -> bool:
 # only aggregated consensus traffic (stable valsets, coalesced vote
 # batches) amortizes — a one-off interactive verify must not populate
 # a cache it will never reuse.
-_HOST_TABLE_MIN = int(os.environ.get("TM_TPU_HOST_TABLE_MIN", "4"))
+_HOST_TABLE_MIN = knobs.knob_int("TM_TPU_HOST_TABLE_MIN", default=4)
 
 
 def verify_many(items) -> list:

@@ -2,12 +2,12 @@
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.keys import address_of
+from tendermint_tpu.utils import clock
 
 
 class VoteType:
@@ -20,7 +20,7 @@ class VoteType:
 
 
 def now_ns() -> int:
-    return time.time_ns()
+    return clock.now_ns()
 
 
 def sign_bytes_template(chain_id: str, block_id, height: int, round_: int,

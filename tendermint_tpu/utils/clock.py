@@ -1,0 +1,38 @@
+"""The one sanctioned wall-clock read for protocol timestamps.
+
+Consensus-adjacent code (vote/proposal timestamps, WAL records, round
+start times, genesis time) needs wall-clock nanoseconds — but scattering
+`time.time_ns()` across consensus/ and types/ made every call site a
+place where nondeterminism could creep in unseen, and left the chaos
+plane's clock-skew faults no seam to inject through. The `determinism`
+checker (analysis/checkers/determinism.py) now flags raw wall-clock
+reads in consensus/, types/, state/ and ops/; this module is where the
+allowed read lives.
+
+`set_source()` lets tests and the chaos plane substitute a deterministic
+or skewed clock for the whole process's protocol timestamps in one
+place. Interval math (timeouts, latency metrics) should keep using
+`time.monotonic()`/`time.perf_counter()` — those are not protocol data
+and the checker does not flag them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+_source: Optional[Callable[[], int]] = None
+
+
+def now_ns() -> int:
+    """Protocol-timestamp nanoseconds (vote/proposal/WAL/genesis time)."""
+    if _source is not None:
+        return _source()
+    return time.time_ns()
+
+
+def set_source(source: Optional[Callable[[], int]]) -> None:
+    """Install a replacement nanosecond source (None restores the real
+    clock). Chaos clock-skew and deterministic replay hook in here."""
+    global _source
+    _source = source

@@ -31,11 +31,11 @@ hold public keys), so cache residency is not a key-hygiene concern.
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import OrderedDict
 
 from tendermint_tpu.utils import ed25519_ref as ref
+from tendermint_tpu.utils import knobs
 
 _P = ref.P
 _L = ref.L
@@ -89,7 +89,7 @@ def _mul_base(s: int):
 # ~60KB of Python ints each, and only live validator keys stay hot.
 
 _INVALID = object()
-_TABLE_MAX = int(os.environ.get("TM_TPU_HOST_TABLE_CACHE", "256"))
+_TABLE_MAX = knobs.knob_int("TM_TPU_HOST_TABLE_CACHE", default=256)
 _tables: "OrderedDict[bytes, object]" = OrderedDict()
 _tables_lock = threading.Lock()
 

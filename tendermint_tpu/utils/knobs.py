@@ -1,0 +1,235 @@
+"""Central catalog of every TM_TPU_* environment knob.
+
+Before this module each subsystem parsed its own env vars with its own
+truthy vocabulary (telemetry accepted "disabled", the coalescer did not;
+burst lower-cased, chaos did not), and nothing guaranteed a knob was
+documented. Now:
+
+- Every knob is declared ONCE here, with its type, default, the config
+  field it shadows (if any), and a one-line description. `scripts/
+  lint.py --knobs-md` renders the catalog to docs/knobs.md, and the
+  `knob-registry` checker (analysis/checkers/knobs.py) fails the build
+  when a TM_TPU_* name is referenced anywhere in the tree without a
+  catalog entry — or when docs/knobs.md drifts from the catalog.
+- The env-wins-over-config contract lives in one place: every helper
+  takes an optional `config=` value and returns env > config > default.
+  An operator exporting a knob must override whatever the config file
+  says (the contract telemetry/burst/chaos/coalescer each restated).
+- Truthy parsing is unified: FALSY is the single vocabulary for "off".
+
+Import-light by design (stdlib `os` only): telemetry, native, and the
+p2p frame plane all read knobs at import time, so this module must not
+import anything of theirs back.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+#: every spelling of "off" accepted anywhere in the tree (superset of
+#: the vocabularies the subsystems had grown independently)
+FALSY = frozenset(("off", "0", "false", "no", "none", "disabled"))
+TRUTHY = frozenset(("on", "1", "true", "yes"))
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str            # TM_TPU_* env var
+    kind: str            # bool | int | float | str | spec
+    default: str         # rendered in docs (the effective default)
+    config: str          # config field it shadows ("" = env-only)
+    description: str     # one line for docs/knobs.md
+    where: str           # module that consumes it
+
+
+# The catalog. Order is the docs order: grouped by subsystem, hot knobs
+# first. Adding a knob here without a consumer is harmless; consuming a
+# TM_TPU_* name absent from here fails `scripts/lint.py`.
+CATALOG: tuple[Knob, ...] = (
+    # -- verification plane ------------------------------------------------
+    Knob("TM_TPU_VERIFIER", "str", "auto", "base.verifier_backend",
+         "Default-verifier backend: auto|jax|python.",
+         "models/verifier.py"),
+    Knob("TM_TPU_MESH", "str", "auto", "base.verifier_mesh",
+         "Verifier device mesh: auto|off|N (power of two).",
+         "models/verifier.py"),
+    Knob("TM_TPU_AUTO_THRESHOLD", "int", "128", "",
+         "Batches at or below this size verify scalar on host.",
+         "models/verifier.py"),
+    Knob("TM_TPU_FETCH_WORKERS", "int", "8", "",
+         "Threads fetching device chunk results concurrently.",
+         "models/verifier.py"),
+    Knob("TM_TPU_COALESCE", "str", "auto", "base.verifier_coalesce",
+         "Cross-call dispatch coalescing: auto|on|off.",
+         "models/verifier.py"),
+    Knob("TM_TPU_COALESCE_WAIT_MS", "float", "2.0",
+         "base.verifier_coalesce_wait_ms",
+         "Max linger per merged dispatch window, milliseconds.",
+         "models/verifier.py"),
+    Knob("TM_TPU_COALESCE_MAX_BATCH", "int", "0 (= BATCH_CHUNK)",
+         "base.verifier_coalesce_max_batch",
+         "Items that force a merged dispatch out early.",
+         "models/verifier.py"),
+    Knob("TM_TPU_HOST_TABLE_MIN", "int", "4", "",
+         "Min host batch size routed to the precomputed-table oracle.",
+         "types/keys.py"),
+    Knob("TM_TPU_HOST_TABLE_CACHE", "int", "256", "",
+         "Per-pubkey double-table LRU capacity (host oracle).",
+         "utils/ed25519_fast.py"),
+    # -- device / native plane ---------------------------------------------
+    Knob("TM_TPU_NO_NATIVE", "bool", "unset (native on)", "",
+         "Any non-empty value disables the native C plane entirely.",
+         "native/__init__.py"),
+    Knob("TM_TPU_NO_PALLAS", "bool", "unset (pallas auto)", "",
+         "Any non-empty value disables the fused pallas kernel path.",
+         "ops/ed25519.py"),
+    # -- p2p frame plane ---------------------------------------------------
+    Knob("TM_TPU_P2P_BURST", "spec", "auto", "base.p2p_burst",
+         "Burst frame plane: off|on|auto|<max packets per burst>.",
+         "p2p/conn/burst.py"),
+    # -- telemetry ---------------------------------------------------------
+    Knob("TM_TPU_TELEMETRY", "bool", "unset (config decides, on)",
+         "base.telemetry",
+         "off disables all metrics/tracing; any other value forces on.",
+         "telemetry/registry.py"),
+    # -- chaos plane -------------------------------------------------------
+    Knob("TM_TPU_CHAOS", "spec", "off", "base.chaos",
+         "Link fault spec, e.g. drop=0.05,delay=0.1,delay_ms=30,seed=7.",
+         "chaos/__init__.py"),
+    # -- analysis / sanitizers ---------------------------------------------
+    Knob("TM_TPU_LOCKCHECK", "bool", "off", "",
+         "on wraps threading locks with the lock-order watchdog "
+         "(analysis/lockwatch.py); chaos runs report cycles.",
+         "analysis/lockwatch.py"),
+)
+
+NAMES = frozenset(k.name for k in CATALOG)
+_BY_NAME = {k.name: k for k in CATALOG}
+
+
+def get(name: str) -> Knob:
+    return _BY_NAME[name]
+
+
+def _check(name: str) -> None:
+    # loud at the call site: an uncataloged knob is a lint failure, and
+    # failing here too means a renamed knob can't silently read defaults
+    if name not in NAMES:
+        raise KeyError(f"{name} is not in the TM_TPU knob catalog "
+                       f"(tendermint_tpu/utils/knobs.py)")
+
+
+def knob_raw(name: str) -> Optional[str]:
+    """The raw env value, stripped; None when unset or blank."""
+    _check(name)
+    v = os.environ.get(name)  # the one sanctioned raw env read —
+    #                           `name` is catalog-checked just above
+    if v is None:
+        return None
+    v = v.strip()
+    return v if v else None
+
+
+def knob_str(name: str, config: Optional[str] = None,
+             default: str = "") -> str:
+    """env > config > default, lower-cased and stripped (mode knobs)."""
+    v = knob_raw(name)
+    if v is not None:
+        return v.lower()
+    if config is not None and str(config).strip():
+        return str(config).strip().lower()
+    return default
+
+
+def knob_spec(name: str, config: Optional[str] = None,
+              default: str = "") -> str:
+    """Like knob_str but case-preserving (spec strings carry values)."""
+    v = knob_raw(name)
+    if v is not None:
+        return v
+    if config is not None and str(config).strip():
+        return str(config).strip()
+    return default
+
+
+def knob_bool(name: str, config: Optional[bool] = None,
+              default: bool = False) -> bool:
+    """env > config > default with the unified truthy vocabulary:
+    FALSY values disable, anything else set enables."""
+    v = knob_raw(name)
+    if v is not None:
+        return v.lower() not in FALSY
+    if config is not None:
+        return bool(config)
+    return default
+
+
+def knob_set(name: str) -> bool:
+    """True when the env var is set non-blank, regardless of value (the
+    TM_TPU_NO_* contract: exporting anything, even \"0\", disables)."""
+    return knob_raw(name) is not None
+
+
+def knob_flag3(name: str) -> Optional[bool]:
+    """Tri-state env flag: None when unset (config decides), False for
+    FALSY values, True otherwise (telemetry's contract)."""
+    v = knob_raw(name)
+    if v is None:
+        return None
+    return v.lower() not in FALSY
+
+
+def knob_int(name: str, config: Optional[int] = None,
+             default: int = 0) -> int:
+    v = knob_raw(name)
+    if v is not None:
+        return int(v)
+    if config is not None:
+        return int(config)
+    return default
+
+
+def knob_float(name: str, config: Optional[float] = None,
+               default: float = 0.0) -> float:
+    v = knob_raw(name)
+    if v is not None:
+        return float(v)
+    if config is not None:
+        return float(config)
+    return default
+
+
+def parse_bool(value: str, default: bool = False) -> bool:
+    """Unified truthy parse for config-file strings (no env read)."""
+    s = str(value).strip().lower()
+    if not s:
+        return default
+    return s not in FALSY
+
+
+def knobs_md() -> str:
+    """Render docs/knobs.md from the catalog (scripts/lint.py
+    --knobs-md writes it; the knob-registry checker fails on drift)."""
+    lines = [
+        "# TM_TPU_* environment knobs",
+        "",
+        "GENERATED by `python scripts/lint.py --knobs-md` from the",
+        "catalog in `tendermint_tpu/utils/knobs.py` — edit there, then",
+        "regenerate. `scripts/lint.py` fails when this file drifts.",
+        "",
+        "Every knob follows the same precedence: **environment wins",
+        "over config wins over default**. An operator exporting a knob",
+        "overrides whatever the config file says. \"Off\" accepts any",
+        "of: " + ", ".join(f"`{v}`" for v in sorted(FALSY)) + ".",
+        "",
+        "| Knob | Type | Default | Config field | Consumer | What it does |",
+        "|---|---|---|---|---|---|",
+    ]
+    for k in CATALOG:
+        cfg = f"`{k.config}`" if k.config else "—"
+        lines.append(f"| `{k.name}` | {k.kind} | {k.default} | {cfg} "
+                     f"| `{k.where}` | {k.description} |")
+    lines.append("")
+    return "\n".join(lines)
