@@ -19,6 +19,23 @@ import os
 import sys
 import time
 
+# Multi-device arms on few-core hosts: TM_TPU_MESH_FORCE_HOST_DEVICES=N
+# must land in XLA_FLAGS before ANYTHING imports jax (XLA reads the
+# flag at backend-client creation). Forced host devices are CPU by
+# definition, so the platform is pinned too. utils/knobs is stdlib-only
+# and safe this early.
+from tendermint_tpu.utils import knobs as _knobs
+
+_FORCED_HOST_DEVICES = _knobs.knob_int("TM_TPU_MESH_FORCE_HOST_DEVICES",
+                                       default=0)
+if _FORCED_HOST_DEVICES:
+    _xf = [f for f in os.environ.get("XLA_FLAGS", "").split()
+           if "xla_force_host_platform_device_count" not in f]
+    _xf.append("--xla_force_host_platform_device_count="
+               f"{_FORCED_HOST_DEVICES}")
+    os.environ["XLA_FLAGS"] = " ".join(_xf)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
 # persistent XLA compilation cache (TPU only — the fused pallas kernel
 # costs minutes per shape on remote-compile setups; on CPU the cache is
 # actively harmful, see bench_util.enable_tpu_compilation_cache)
@@ -446,6 +463,208 @@ def bench_p2p_json(path: str = "BENCH_p2p.json",
     return doc
 
 
+def _mesh_commit_data(n: int, tamper=(137, 4242, 9001)):
+    """The deterministic n-validator synthetic commit as prepared
+    device arrays + tx-leaf digests, with a few signatures corrupted so
+    the sharded/unsharded bit-equality check has real negative lanes.
+    No jax anywhere — the parent builds this once and ships it to the
+    per-device-count subprocess arms via one npz."""
+    import numpy as np
+    from bench_util import fast_signer
+    from tendermint_tpu.ops import ed25519, merkle
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    tamper = tuple(i for i in tamper if i < n)
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = (i + 1).to_bytes(32, "little")
+        m = b'{"@chain_id":"bench","@type":"vote","height":1,"round":0,' + \
+            b'"idx":' + str(i).encode() + b"}"
+        sig = fast_signer(seed)(m)
+        if i in tamper:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]  # corrupt R, keep s < L
+        pubs.append(ref.public_key(seed))
+        msgs.append(m)
+        sigs.append(sig)
+    pk, rb, sb, hb, pre = ed25519.prepare_batch_bytes(pubs, msgs, sigs)
+    assert pre.all()  # tampered lanes are well-formed, just invalid
+    digests = np.stack([np.frombuffer(merkle.leaf_hash(m), np.uint8)
+                        for m in msgs])
+    return {"pk": pk, "rb": rb, "sb": sb, "hb": hb, "digests": digests,
+            "tampered": np.array(tamper, np.int64)}
+
+
+def mesh_arm(data_path: str, baseline_path: str) -> dict:
+    """One point of the mesh scaling curve, run inside a subprocess
+    whose device count TM_TPU_MESH_FORCE_HOST_DEVICES pinned at import:
+    the full commit batch through parallel/mesh.py's sharded verify
+    kernel and sharded Merkle root on a mesh over EVERY device present.
+    The 1-device arm runs the degenerate (plain-kernel) path and saves
+    its verdict bits; wider arms must match them bit for bit."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ed25519, merkle
+    from tendermint_tpu.parallel import mesh as pmesh
+
+    data = np.load(data_path)
+    pk, rb = data["pk"], data["rb"]
+    digests = data["digests"]
+    tampered = set(int(i) for i in data["tampered"])
+    n = pk.shape[0]
+    d = len(jax.devices())
+    # 512-multiple padding (the tile the headline bench uses): 10000 ->
+    # 10240, divisible by every power-of-two mesh width up to 512
+    m = ((n + 511) // 512) * 512
+    sbits = ed25519._bits_le(ed25519._pad_to(data["sb"], m))
+    hbits = ed25519._bits_le(ed25519._pad_to(data["hb"], m))
+    args = (jnp.asarray(ed25519._pad_to(pk, m)),
+            jnp.asarray(ed25519._pad_to(rb, m)),
+            jnp.asarray(sbits), jnp.asarray(hbits))
+
+    mesh = pmesh.make_mesh(d)
+    kernel = pmesh.sharded_verify_kernel(mesh)
+
+    t0 = time.perf_counter()
+    out = kernel(*args)
+    out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+
+    reps = int(os.environ.get("TM_BENCH_MESH_REPS", "1"))
+    trials = int(os.environ.get("TM_BENCH_MESH_TRIALS", "1"))
+    trial_ms = []
+    dt = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = kernel(*args)
+        out.block_until_ready()
+        t = (time.perf_counter() - t0) / reps
+        trial_ms.append(round(t * 1e3, 1))
+        dt = min(dt, t)
+
+    verdict = np.asarray(out)[:n]
+    assert all(bool(verdict[i]) == (i not in tampered)
+               for i in range(n)), "verdict content wrong"
+    equal = None
+    if d == 1:
+        np.save(baseline_path, verdict)
+    elif os.path.exists(baseline_path):
+        equal = bool(np.array_equal(verdict, np.load(baseline_path)))
+        assert equal, "sharded verdicts differ from the unsharded kernel"
+
+    # sharded Merkle root of the same commit's message digests,
+    # bit-compared against the host (native/hashlib) spec path
+    root_kernel = pmesh.sharded_merkle_root(mesh)
+    padded = merkle.pad_digests(digests)
+    t0 = time.perf_counter()
+    got_root = np.asarray(root_kernel(jnp.asarray(padded), n)).tobytes()
+    merkle_compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got_root = np.asarray(root_kernel(jnp.asarray(padded), n)).tobytes()
+    merkle_ms = (time.perf_counter() - t0) * 1e3
+    assert got_root == merkle.root_from_digests_host(digests.tobytes()), \
+        "sharded Merkle root differs from the host spec"
+
+    return {
+        "devices": d,
+        "impl": pmesh.shard_map_impl()[0],
+        "n_sigs": n,
+        "padded": m,
+        "compile_s": round(compile_s, 1),
+        "verify_ms_per_batch": round(dt * 1e3, 1),
+        "verifies_per_sec": round(n / dt, 1),
+        "trial_ms": trial_ms,
+        "verify_equal_unsharded": equal,
+        "merkle_root_ms": round(merkle_ms, 1),
+        "merkle_compile_s": round(merkle_compile_s, 1),
+        "merkle_equal_host": True,
+        "shard_occupancy": round(n / m, 4),
+    }
+
+
+def bench_mesh_json(path: str = "BENCH_mesh.json") -> dict:
+    """Mesh trajectory point (ISSUE 6): the 10k-signature commit
+    through the sharded verify + Merkle kernels at 1/2/4/8 forced host
+    devices — each device count in its OWN subprocess so XLA sees
+    exactly N devices (`--xla_force_host_platform_device_count=N` via
+    TM_TPU_MESH_FORCE_HOST_DEVICES, applied before jax init). The
+    1-device arm is the unsharded baseline; every wider arm's verdict
+    bits and Merkle root must match it exactly."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    n = int(os.environ.get("TM_BENCH_MESH_SIGS", "10000"))
+    counts = sorted(int(c) for c in os.environ.get(
+        "TM_BENCH_MESH_DEVICES", "1,2,4,8").split(","))
+    print(f"[bench] mesh: signing the {n}-signature commit...",
+          file=sys.stderr, flush=True)
+    data = _mesh_commit_data(n)
+    tmp = tempfile.mkdtemp(prefix="tm_mesh_bench_")
+    data_path = os.path.join(tmp, "commit.npz")
+    baseline_path = os.path.join(tmp, "verdicts_1dev.npy")
+    np.savez(data_path, **data)
+
+    points = []
+    for d in counts:
+        print(f"[bench] mesh arm devices={d}...", file=sys.stderr,
+              flush=True)
+        env = dict(os.environ)
+        env["TM_TPU_MESH_FORCE_HOST_DEVICES"] = str(d)
+        env["TM_TPU_MESH"] = "off"  # arms drive the kernels directly;
+        #                             the host Merkle reference must
+        #                             stay on the host path
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-arm",
+             data_path, baseline_path],
+            env=env, capture_output=True, text=True,
+            timeout=float(os.environ.get("TM_BENCH_MESH_ARM_TIMEOUT_S",
+                                         "1800")))
+        if proc.returncode != 0:
+            points.append({"devices": d,
+                           "error": proc.stderr.strip()[-800:]})
+            continue
+        point = json.loads(proc.stdout.strip().splitlines()[-1])
+        point["arm_wall_s"] = round(time.perf_counter() - t0, 1)
+        points.append(point)
+        print(f"[bench] mesh arm devices={d} done in "
+              f"{point['arm_wall_s']}s", file=sys.stderr, flush=True)
+
+    base = next((p for p in points
+                 if p.get("devices") == 1 and "error" not in p), None)
+    for p in points:
+        if base and "error" not in p:
+            p["speedup_vs_1dev"] = round(
+                base["verify_ms_per_batch"] / p["verify_ms_per_batch"],
+                2)
+    doc = {
+        "metric": "mesh_sharded_verify_10k_commit",
+        "unit": "verifies/sec",
+        "workload": f"{n}-signature synthetic commit (3 tampered lanes)"
+                    ", sharded Ed25519 verify + sharded Merkle root per"
+                    " forced-host-device count, one subprocess per arm",
+        "source": "parallel/mesh.py kernels; 1-device arm = unsharded "
+                  "baseline, wider arms bit-compared against it",
+        "knobs": {"TM_TPU_MESH_FORCE_HOST_DEVICES": "per arm",
+                  "XLA_FLAGS": "--xla_force_host_platform_device_count"
+                               "=N (derived)"},
+        "host_cpu_count": os.cpu_count(),
+        "points": points,
+        "note": "forced host devices share the physical cores, so this "
+                "curve proves sharded/unsharded bit-equality and "
+                "measures sharding overhead — not multi-chip speedup; "
+                "wall-clock scaling needs devices with their own "
+                "compute (docs/perf.md).",
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def main() -> int:
     import numpy as np
     import jax
@@ -850,6 +1069,20 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--mesh-arm" in sys.argv:
+        # internal: one device-count point of the mesh curve, run by
+        # bench_mesh_json in a subprocess whose device count the env
+        # already pinned (see the TM_TPU_MESH_FORCE_HOST_DEVICES block
+        # at the top of this file)
+        _i = sys.argv.index("--mesh-arm")
+        print(json.dumps(mesh_arm(sys.argv[_i + 1], sys.argv[_i + 2])),
+              flush=True)
+        sys.exit(0)
+    if "--mesh-json" in sys.argv:
+        # standalone quick mode: only the BENCH_mesh.json satellite
+        # (1/2/4/8-device sharded verify + Merkle scaling curve)
+        print(json.dumps(bench_mesh_json()), flush=True)
+        sys.exit(0)
     if "--coalesce-json" in sys.argv:
         # standalone quick mode: only the BENCH_coalesce.json satellite
         print(json.dumps(bench_coalesce_json()), flush=True)

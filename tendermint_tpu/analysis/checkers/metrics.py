@@ -28,13 +28,14 @@ CHECKER_ID = "metrics"
 KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
-    "chaos",
+    "chaos", "mesh",
 }
 
 INSTRUMENTED_MODULES = [
     "tendermint_tpu.models.verifier",
     "tendermint_tpu.models.coalescer",
     "tendermint_tpu.ops.merkle",
+    "tendermint_tpu.parallel.mesh",      # tm_mesh_* sharded dispatches
     "tendermint_tpu.consensus.state",
     "tendermint_tpu.mempool.mempool",
     "tendermint_tpu.blockchain.pool",

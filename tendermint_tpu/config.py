@@ -24,7 +24,9 @@ class BaseConfig:
     # signature-verification plane (no reference equivalent — the
     # reference verifies scalar on one core, types/validator_set.go:257):
     # backend auto|jax|python; mesh auto|off|N shards verify batches over
-    # the device mesh (models/verifier.py)
+    # the device mesh (models/verifier.py). The env knob TM_TPU_MESH
+    # additionally routes big ops/merkle roots (tx root, part-set root)
+    # through the same mesh — see docs/knobs.md.
     verifier_backend: str = "auto"
     verifier_mesh: str = "auto"
     # cross-call dispatch coalescing (models/coalescer.py): merge

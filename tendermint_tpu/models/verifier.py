@@ -40,6 +40,9 @@ from typing import Callable, Sequence
 import numpy as np
 
 from tendermint_tpu import telemetry
+# import-light: parallel.mesh only pulls jax inside its kernel builders,
+# so the spec helpers + tm_mesh_* instruments cost nothing at import
+from tendermint_tpu.parallel import mesh as _pmesh
 from tendermint_tpu.utils import knobs
 
 # The paper's headline metric is sig-verifies/sec/chip; these families
@@ -62,6 +65,9 @@ _m_occupancy = telemetry.histogram(
     "verifier_chunk_occupancy",
     "Per-chunk fill ratio vs the padded power-of-two bucket",
     buckets=telemetry.RATIO_BUCKETS)
+_m_mesh_devices = telemetry.gauge(
+    "verifier_mesh_devices",
+    "Devices in the verifier's active sharding mesh (0 = unsharded)")
 
 # Per-dispatch chunk. The fused pallas kernel tiles batches internally
 # (512/VMEM tile), so big dispatches amortize launch overhead; the sweep
@@ -105,10 +111,8 @@ def _fetch_pool_get():
 def _mesh_kernel(n_devices: int) -> Callable:
     with _mesh_lock:
         if n_devices not in _mesh_kernels:
-            from tendermint_tpu.parallel.mesh import (make_mesh,
-                                                      sharded_verify_kernel)
-            _mesh_kernels[n_devices] = sharded_verify_kernel(
-                make_mesh(n_devices))
+            _mesh_kernels[n_devices] = _pmesh.sharded_verify_kernel(
+                _pmesh.make_mesh(n_devices))
         return _mesh_kernels[n_devices]
 
 
@@ -126,25 +130,9 @@ def _parse_coalesce_spec(spec: str) -> str:
         f"verifier coalesce must be auto|on|off, got {spec!r}")
 
 
-def _parse_mesh_spec(mesh: str) -> str | int:
-    """'auto' | 'off' | power-of-two int. Raises ValueError on anything
-    else — callers (Node.__init__) validate the config knob eagerly so a
-    typo fails at startup, not at the first batched verify where callers'
-    `except ValueError` handlers would misread it as bad peer data."""
-    s = str(mesh).strip().lower()
-    if s in ("auto", ""):
-        return "auto"
-    if s in ("off", "0", "1", "none"):
-        return "off"
-    try:
-        n = int(s)
-    except ValueError:
-        raise ValueError(
-            f"verifier mesh must be auto|off|N, got {mesh!r}") from None
-    if n < 2 or n & (n - 1):
-        raise ValueError(
-            f"verifier mesh size must be a power of two >= 2, got {n}")
-    return n
+# 'auto' | 'off' | power-of-two int, validated eagerly (shared with the
+# ops.merkle mesh dispatch — one spec grammar for the whole device plane)
+_parse_mesh_spec = _pmesh.parse_mesh_spec
 
 
 class BatchVerifier:
@@ -221,20 +209,15 @@ class BatchVerifier:
                 # no usable backend; plain kernel path will surface it
                 self._mesh_resolved = True
                 return
-            if self.mesh == "auto":
-                n = 1
-                while n * 2 <= n_avail:
-                    n *= 2
-            else:
-                n = self.mesh
-                if n > n_avail:
-                    raise RuntimeError(
-                        f"verifier mesh={n} but only {n_avail} "
-                        f"devices present")
+            # explicit N > available raises RuntimeError (loud, and not
+            # a bad-peer-data signal) before _mesh_resolved flips
+            n = _pmesh.resolve_mesh_size(self.mesh, n_avail)
             if n >= 2:
                 self.kernel = _mesh_kernel(n)
                 self.mesh_devices = n
                 self._min_bucket = max(8, n)
+            if telemetry.enabled():
+                _m_mesh_devices.set(self.mesh_devices)
             self._mesh_resolved = True
 
     def verify(self, items: Sequence[tuple[bytes, bytes, bytes]]) -> np.ndarray:
@@ -332,8 +315,10 @@ class BatchVerifier:
                     kernel=self.kernel, min_bucket=self._min_bucket)
                 pending.append((lo, hi, res, pre[lo:hi]))
                 if occ:
-                    _m_occupancy.observe((hi - lo) / ed25519._bucket(
-                        hi - lo, min_size=self._min_bucket))
+                    b = ed25519._bucket(hi - lo, min_size=self._min_bucket)
+                    _m_occupancy.observe((hi - lo) / b)
+                    if self.mesh_devices >= 2:
+                        _pmesh.record_dispatch("verify", hi - lo, b)
             return self._make_resolver(n, pending, t_dispatch=t_dispatch)
         # mixed-key routing: 33-byte compressed-SEC1 pubkeys are
         # secp256k1 — verified on host (off the TPU hot path by design,
@@ -385,8 +370,10 @@ class BatchVerifier:
                 min_bucket=self._min_bucket)
             pending.append((lo, hi, res, pre))
             if occ:
-                _m_occupancy.observe((hi - lo) / ed25519._bucket(
-                    hi - lo, min_size=self._min_bucket))
+                b = ed25519._bucket(hi - lo, min_size=self._min_bucket)
+                _m_occupancy.observe((hi - lo) / b)
+                if self.mesh_devices >= 2:
+                    _pmesh.record_dispatch("verify", hi - lo, b)
         return self._make_resolver(n, pending, t_dispatch=t_dispatch)
 
     def _record_jax_dispatch(self, n: int) -> None:

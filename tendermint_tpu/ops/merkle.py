@@ -25,6 +25,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import struct
+import threading
 
 import numpy as np
 
@@ -47,6 +48,87 @@ _m_leaves = telemetry.histogram(
     buckets=telemetry.POW2_BUCKETS)
 _m_proofs = telemetry.counter(
     "merkle_proofs_total", "Merkle proofs computed on host")
+
+
+# ---------------------------------------------------------------------------
+# Mesh dispatch — big roots shard over the verifier's device mesh
+# ---------------------------------------------------------------------------
+# The same TM_TPU_MESH knob that shards BatchVerifier batches routes the
+# host-facing root entry points (tx root, part-set root, results hash)
+# through parallel/mesh.py's sharded Merkle kernel once the tree is big
+# enough to amortize a device dispatch. Sub-threshold trees — small
+# part sets, header field maps — stay on the native/hashlib host path.
+
+# leaves below this stay on host (mirrors the verifier's auto_threshold
+# split: interactive sizes skip the dispatch round trip entirely)
+_MESH_MIN_LEAVES = 64
+_mesh_lock = threading.Lock()
+# None = unresolved; (kernel, n_devices) once resolved ((None, 1) = no
+# mesh). Tests monkeypatch this to force a kernel in.
+_mesh_state: "tuple | None" = None
+
+
+def _mesh_root_kernel() -> "tuple":
+    """(sharded root kernel | None, n_devices), resolved lazily.
+
+    Resolution mirrors BatchVerifier._resolve_mesh (same TM_TPU_MESH
+    grammar via parallel.mesh) with one extra guard: under the default
+    spec 'auto' the mesh is only considered when jax is ALREADY
+    imported in this process — a plain CPU node hashing on host must
+    never pay the multi-second jax init for a Merkle root. That
+    undecided state is NOT cached, so the first root after something
+    else brings jax up (a batched verify) resolves for real. An
+    explicit TM_TPU_MESH=N opts in unconditionally and raises, loudly,
+    when N exceeds the devices present — same contract as the
+    verifier."""
+    global _mesh_state
+    st = _mesh_state
+    if st is not None:
+        return st
+    with _mesh_lock:
+        if _mesh_state is not None:
+            return _mesh_state
+        import sys
+        from tendermint_tpu.utils import knobs
+        from tendermint_tpu.parallel import mesh as pmesh
+        spec = pmesh.parse_mesh_spec(
+            knobs.knob_str("TM_TPU_MESH", default="auto"))
+        if spec == "off":
+            _mesh_state = (None, 1)
+            return _mesh_state
+        if spec == "auto" and "jax" not in sys.modules:
+            return (None, 1)  # undecided — do not cache
+        try:
+            import jax
+            n_avail = len(jax.devices())
+        except Exception:
+            _mesh_state = (None, 1)  # no usable backend, ever
+            return _mesh_state
+        n = pmesh.resolve_mesh_size(spec, n_avail)
+        if n < 2:
+            _mesh_state = (None, 1)
+        else:
+            _mesh_state = (pmesh.sharded_merkle_root(pmesh.make_mesh(n)),
+                           n)
+        return _mesh_state
+
+
+def _mesh_root_from_digest_rows(rows: np.ndarray, n: int) -> "bytes | None":
+    """Sharded device root of uint8[n, 32] leaf digests, or None when
+    no mesh is active / the tree is too small for its width."""
+    if n < _MESH_MIN_LEAVES:
+        return None
+    kernel, ndev = _mesh_root_kernel()
+    if kernel is None or _padded_size(n) < ndev:
+        return None
+    import jax.numpy as jnp  # already imported per the resolve policy
+    from tendermint_tpu.parallel import mesh as pmesh
+    padded = pad_digests(rows)
+    pmesh.record_dispatch("merkle", n, padded.shape[0])
+    if telemetry.enabled():
+        _m_roots.labels("mesh").inc()
+        _m_leaves.observe(n)
+    return np.asarray(kernel(jnp.asarray(padded), n)).tobytes()
 
 
 # ---------------------------------------------------------------------------
@@ -73,9 +155,17 @@ def _padded_size(n: int) -> int:
 
 
 def root_host(items: list[bytes]) -> bytes:
-    """Merkle root of raw items, entirely on host. Uses the native C++
+    """Merkle root of raw items. Big trees shard over the active device
+    mesh (TM_TPU_MESH, see _mesh_root_kernel); otherwise the native C++
     tree builder (native/hostops.cpp) when available — one C call per
     tree instead of 2n hashlib round trips."""
+    n = len(items)
+    if n >= _MESH_MIN_LEAVES and _mesh_root_kernel()[0] is not None:
+        rows = np.stack(
+            [np.frombuffer(leaf_hash(it), np.uint8) for it in items])
+        out = _mesh_root_from_digest_rows(rows, n)
+        if out is not None:
+            return out
     from tendermint_tpu import native
     out = native.merkle_root(items)
     if out is not None:
@@ -93,6 +183,14 @@ def root_from_digests_host(digests) -> bytes:
     n = len(digests) // 32 if flat else len(digests)
     if n == 0:
         return _final_hash(0, EMPTY_DIGEST)
+    if n >= _MESH_MIN_LEAVES and _mesh_root_kernel()[0] is not None:
+        if flat:
+            rows = np.frombuffer(bytes(digests), np.uint8).reshape(n, 32)
+        else:
+            rows = np.stack([np.frombuffer(d, np.uint8) for d in digests])
+        out = _mesh_root_from_digest_rows(rows, n)
+        if out is not None:
+            return out
     if telemetry.enabled():
         _m_leaves.observe(n)
     from tendermint_tpu import native

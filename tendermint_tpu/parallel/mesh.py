@@ -7,6 +7,28 @@ verdicts stay sharded (or gather with one small all_gather). The Merkle
 kernel reduces its local subtree per chip, then all_gathers the 32-byte
 subtree roots — bytes over ICI per root are 32·n_devices, negligible.
 
+The shard_map API has moved across JAX releases; `shard_map_impl()`
+feature-detects once per process and every kernel builder routes
+through it:
+
+  1. `jax.shard_map`                      — the modern top-level API
+     (takes `check_vma`),
+  2. `jax.experimental.shard_map.shard_map` — the long-lived staging
+     home (takes `check_rep`),
+  3. plain `jax.jit` + `NamedSharding` in_shardings/out_shardings —
+     no shard_map at all; GSPMD partitions the same batch axis from
+     the sharding annotations alone.
+
+All three express the identical partitioning, so verdict/root bytes are
+independent of which one the installed JAX provides. A 1-device mesh is
+a degenerate no-op: the builders hand back the plain unsharded jit
+kernels, so callers never branch on mesh size.
+
+jax itself is imported lazily (inside the builders): this module also
+hosts the mesh spec helpers and the `tm_mesh_*` telemetry, which the
+verifier/Merkle dispatch and the lint's metric catalog import from
+plain-CPU processes that must not pay jax init.
+
 Replaces nothing in the reference — this parallel axis does not exist
 there (types/validator_set.go:240-265 is a serial loop on one core).
 """
@@ -14,26 +36,120 @@ there (types/validator_set.go:240-265 is a serial loop on one core).
 from __future__ import annotations
 
 import functools
+import struct
 from typing import Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tendermint_tpu.ops import curve, merkle, sha256
-from tendermint_tpu.ops.ed25519 import verify_kernel
-
+from tendermint_tpu import telemetry
 
 _mesh_cache: dict = {}
 _kernel_cache: dict = {}
+_impl = None  # ("shard_map" | "jit", wrapped shard_map fn | None)
+
+# One dispatch = one sharded kernel launch from the verifier or the
+# Merkle root plane. Occupancy is real rows / padded rows — with the
+# contiguous padding layout that is also the mean per-shard fill, and
+# a low value means most chips are hashing zero rows.
+_m_dispatch = telemetry.counter(
+    "mesh_dispatch_total", "Sharded-kernel dispatches", ("kind",))
+_m_occupancy = telemetry.histogram(
+    "mesh_shard_occupancy",
+    "Real (unpadded) rows / padded rows per sharded dispatch",
+    buckets=telemetry.RATIO_BUCKETS)
 
 
-def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+def record_dispatch(kind: str, n_real: int, n_padded: int) -> None:
+    """Telemetry hook for every sharded dispatch (verifier chunk loop,
+    Merkle root plane). No-op when telemetry is off."""
+    if not telemetry.enabled():
+        return
+    _m_dispatch.labels(kind).inc()
+    if n_padded > 0:
+        _m_occupancy.observe(n_real / n_padded)
+
+
+# ---------------------------------------------------------------------------
+# Spec helpers (shared by models/verifier.py and ops/merkle.py)
+# ---------------------------------------------------------------------------
+
+def parse_mesh_spec(mesh) -> "str | int":
+    """'auto' | 'off' | power-of-two int. Raises ValueError on anything
+    else — callers (Node.__init__, BatchVerifier) validate the config
+    knob eagerly so a typo fails at startup, not at the first batched
+    verify where callers' `except ValueError` handlers would misread it
+    as bad peer data."""
+    s = str(mesh).strip().lower()
+    if s in ("auto", ""):
+        return "auto"
+    if s in ("off", "0", "1", "none"):
+        return "off"
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"verifier mesh must be auto|off|N, got {mesh!r}") from None
+    if n < 2 or n & (n - 1):
+        raise ValueError(
+            f"verifier mesh size must be a power of two >= 2, got {n}")
+    return n
+
+
+def resolve_mesh_size(spec, n_avail: int) -> int:
+    """Device count a parsed spec resolves to on an n_avail-device host.
+    'off' -> 1; 'auto' -> the largest power of two that fits (sharding
+    needs the padded batch axis divisible by the mesh; buckets are
+    powers of two); explicit N > n_avail raises RuntimeError, which no
+    verify-path caller catches as a bad-input signal."""
+    if spec == "off":
+        return 1
+    if spec == "auto":
+        n = 1
+        while n * 2 <= n_avail:
+            n *= 2
+        return n
+    if spec > n_avail:
+        raise RuntimeError(
+            f"verifier mesh={spec} but only {n_avail} devices present")
+    return spec
+
+
+def shard_map_impl():
+    """('shard_map', fn) or ('jit', None), feature-detected once per
+    process: fn is the installed shard_map entry point with its
+    replication-check kwarg (check_vma on modern JAX, check_rep on the
+    jax.experimental staging API) already bound off."""
+    global _impl
+    if _impl is None:
+        import inspect
+
+        import jax
+        fn = getattr(jax, "shard_map", None)
+        if fn is None:
+            try:
+                from jax.experimental.shard_map import shard_map as fn
+            except ImportError:
+                fn = None
+        if fn is None:
+            _impl = ("jit", None)
+        else:
+            kw = {}
+            params = inspect.signature(fn).parameters
+            if "check_vma" in params:
+                kw["check_vma"] = False
+            elif "check_rep" in params:
+                kw["check_rep"] = False
+            _impl = ("shard_map", functools.partial(fn, **kw) if kw else fn)
+    return _impl
+
+
+def make_mesh(n_devices: Optional[int] = None):
     """Mesh over the first n devices, CACHED per device count: every
     Mesh/shard_map/jit closure combination owns its own compile cache,
     so handing out one object per size lets all callers (verifier,
-    dryrun, tests) share compiled executables."""
+    merkle dispatch, dryrun, tests) share compiled executables."""
+    import jax
+    from jax.sharding import Mesh
     devs = jax.devices()
     n = n_devices or len(devs)
     if n not in _mesh_cache:
@@ -41,69 +157,100 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return _mesh_cache[n]
 
 
-def sharded_verify_kernel(mesh: Mesh):
+def sharded_verify_kernel(mesh):
     """Returns verify(pubkeys u8[N,32], r u8[N,32], s_bits i32[N,256],
     h_bits i32[N,256]) -> bool[N], with N sharded over mesh's `batch` axis.
     Drop-in `kernel=` for ops.ed25519.verify_batch / BatchVerifier.
-    Cached per mesh (compiles are minutes on 1-core CI hosts)."""
+    Cached per mesh (compiles are minutes on 1-core CI hosts). A
+    1-device mesh degenerates to the plain unsharded jit kernel."""
     key = ("verify", id(mesh))
     if key in _kernel_cache:
         return _kernel_cache[key]
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P("batch"), P("batch"), P("batch"), P("batch")),
-        out_specs=P("batch"), check_vma=False)
-    def _local(pk, rb, sbits, hbits):
-        return verify_kernel(pk, rb, sbits, hbits)
+    from tendermint_tpu.ops.ed25519 import verify_kernel, verify_kernel_jit
 
-    @jax.jit
-    def _verify(pk, rb, sbits, hbits):
-        return _local(pk, rb, sbits, hbits)
+    if mesh.devices.size == 1:
+        _kernel_cache[key] = verify_kernel_jit
+        return verify_kernel_jit
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    api, smap = shard_map_impl()
+    if api == "shard_map":
+        _local = smap(verify_kernel, mesh=mesh,
+                      in_specs=(P("batch"), P("batch"), P("batch"),
+                                P("batch")),
+                      out_specs=P("batch"))
+        _verify = jax.jit(_local)
+    else:
+        sh = NamedSharding(mesh, P("batch"))
+        _verify = jax.jit(verify_kernel, in_shardings=(sh, sh, sh, sh),
+                          out_shardings=sh)
 
     _kernel_cache[key] = _verify
     return _verify
 
 
-def sharded_merkle_root(mesh: Mesh):
+def sharded_merkle_root(mesh):
     """Returns root(digests u8[M,32], n_leaves) -> u8[32]; leaf digests
     sharded over `batch`, local subtree reduced per chip, subtree roots
     all_gathered and finished identically on every chip. Cached per
-    mesh, like sharded_verify_kernel."""
+    mesh, like sharded_verify_kernel; a 1-device mesh degenerates to
+    the plain device root."""
     key = ("merkle", id(mesh))
     if key in _kernel_cache:
         return _kernel_cache[key]
 
-    n_dev = mesh.devices.size
+    from tendermint_tpu.ops import merkle, sha256
 
-    @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=P("batch"), out_specs=P(),
-                       check_vma=False)
-    def _subtree(digests):
-        level = digests
-        while level.shape[-2] > 1:
-            level = merkle._level_up(level)
-        # [1, 32] per chip -> all chips see all subtree roots [n_dev, 32]
-        roots = jax.lax.all_gather(level[0], "batch")
-        while roots.shape[-2] > 1:
-            roots = merkle._level_up(roots)
-        return roots[0]
+    if mesh.devices.size == 1:
+        _kernel_cache[key] = merkle.root_from_digests
+        return merkle.root_from_digests
 
-    @functools.partial(jax.jit, static_argnames=("n_leaves",))
-    def _root(digests, n_leaves: int):
-        tree_root = _subtree(digests)
-        import struct
-        header = np.concatenate([
-            np.array([0x02], np.uint8),
-            np.frombuffer(struct.pack("<Q", n_leaves), np.uint8)])
-        return sha256.hash_fixed(
-            jnp.concatenate([jnp.asarray(header), tree_root], axis=-1))
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    api, smap = shard_map_impl()
+    if api == "shard_map":
+        def _subtree_local(digests):
+            level = digests
+            while level.shape[-2] > 1:
+                level = merkle._level_up(level)
+            # [1, 32] per chip -> all chips see all subtree roots
+            # [n_dev, 32]
+            roots = jax.lax.all_gather(level[0], "batch")
+            while roots.shape[-2] > 1:
+                roots = merkle._level_up(roots)
+            return roots[0]
+
+        _subtree = smap(_subtree_local, mesh=mesh,
+                        in_specs=P("batch"), out_specs=P())
+
+        @functools.partial(jax.jit, static_argnames=("n_leaves",))
+        def _root(digests, n_leaves: int):
+            tree_root = _subtree(digests)
+            header = np.concatenate([
+                np.array([0x02], np.uint8),
+                np.frombuffer(struct.pack("<Q", n_leaves), np.uint8)])
+            return sha256.hash_fixed(
+                jnp.concatenate([jnp.asarray(header), tree_root], axis=-1))
+    else:
+        # GSPMD partitions the level-by-level reduction from the input
+        # sharding alone; the upper levels reshard automatically once
+        # rows < n_devices. Bit-identical output (SHA-256 is SHA-256).
+        sh = NamedSharding(mesh, P("batch"))
+        rep = NamedSharding(mesh, P())
+        _root = jax.jit(merkle._root_from_digests,
+                        static_argnames=("n_leaves",),
+                        in_shardings=(sh,), out_shardings=rep)
 
     _kernel_cache[key] = _root
     return _root
 
 
-def verify_step(mesh: Mesh):
+def verify_step(mesh):
     """The flagship 'full step' over the mesh: batched commit verification
     + Merkle root of the same batch's messages-digests — i.e. everything a
     fast-sync block check does on-device, sharded. Returns

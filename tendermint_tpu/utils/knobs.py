@@ -53,8 +53,13 @@ CATALOG: tuple[Knob, ...] = (
          "Default-verifier backend: auto|jax|python.",
          "models/verifier.py"),
     Knob("TM_TPU_MESH", "str", "auto", "base.verifier_mesh",
-         "Verifier device mesh: auto|off|N (power of two).",
-         "models/verifier.py"),
+         "Device mesh for sharded verify + Merkle roots: auto|off|N "
+         "(power of two).",
+         "models/verifier.py, ops/merkle.py"),
+    Knob("TM_TPU_MESH_FORCE_HOST_DEVICES", "int", "0 (off)", "",
+         "Force N virtual XLA host (CPU) devices before jax init — "
+         "the bench/CI arm for multi-device runs on few-core hosts.",
+         "bench.py"),
     Knob("TM_TPU_AUTO_THRESHOLD", "int", "128", "",
          "Batches at or below this size verify scalar on host.",
          "models/verifier.py"),

@@ -2,6 +2,13 @@
 
 Tests must not depend on real TPU hardware; multi-chip sharding paths
 are exercised on a virtual CPU mesh exactly as the driver's dryrun does.
+This is also the CI multi-device story (ISSUE 6): every tier-1 run gets
+`--xla_force_host_platform_device_count=8` (override via
+TM_TPU_MESH_FORCE_HOST_DEVICES, the same knob bench.py's mesh arms
+use), so the shard_map/NamedSharding code paths run on 1-core hosts on
+every push — 8 covers the 2- and 4-wide sub-meshes the mesh tests also
+exercise. Only tests that explicitly build a mesh pay a sharded
+compile; TM_TPU_MESH defaults to "off" below so nothing else does.
 
 On hosts where a TPU PJRT plugin is registered from sitecustomize (the
 axon tunnel pins JAX_PLATFORMS=axon before any of our code runs), env
@@ -24,7 +31,8 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
           if "xla_force_host_platform_device_count" not in f
           and "xla_backend_optimization_level" not in f]
-_flags.append("--xla_force_host_platform_device_count=8")
+_n_dev = (os.environ.get("TM_TPU_MESH_FORCE_HOST_DEVICES") or "8").strip()
+_flags.append(f"--xla_force_host_platform_device_count={_n_dev}")
 # the suite is COMPILE-bound on this 1-core host (the interpreted pallas
 # kernel alone costs ~4 min at full opt); O0 keeps semantics, cuts ~30%
 if not os.environ.get("TM_TEST_NO_O0"):

@@ -154,6 +154,149 @@ def test_batch_verifier_mesh_spec_errors():
         BatchVerifier("jax", mesh="64")._resolve_mesh()
 
 
+def test_mesh_auto_noop_on_single_device_host(monkeypatch):
+    """mesh='auto' on a 1-device host is a no-op: no sharded kernel, no
+    min-bucket bump, scalar-friendly defaults untouched — and an
+    explicit mesh=N beyond the host raises the loud RuntimeError (the
+    knob contract, not a bad-peer-data signal)."""
+    from tendermint_tpu.models.verifier import BatchVerifier
+
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a: one)
+    v = BatchVerifier("jax", mesh="auto")
+    v._resolve_mesh()
+    assert v._mesh_resolved
+    assert v.kernel is None and v.mesh_devices == 0
+    assert v._min_bucket == 8
+    with pytest.raises(RuntimeError):
+        BatchVerifier("jax", mesh="2")._resolve_mesh()
+
+
+def test_coalesced_batches_pad_mesh_divisible():
+    """Cross-caller batches merged by the dispatch coalescer (PR 2)
+    land on the sharded kernel with a mesh-divisible padded axis: the
+    mesh-derived min bucket flows through _verify_async_direct (the
+    coalescer's merge target), so every dispatched shape is a power of
+    two >= the mesh width. Forced 4-device mesh on the 8-device host."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tendermint_tpu.models.verifier import BatchVerifier
+
+    pubs, msgs, sigs = signed_batch(8, tamper={5})
+    items = list(zip(pubs, msgs, sigs))
+
+    v = BatchVerifier("jax", mesh="4", coalesce="on",
+                      coalesce_wait_ms=25.0)
+    v._resolve_mesh()
+    assert v.mesh_devices == 4 and v._min_bucket == 8
+
+    shapes = []
+    inner = v.kernel
+
+    def recording(pk, rb, sbits, hbits):
+        shapes.append(int(pk.shape[0]))
+        return inner(pk, rb, sbits, hbits)
+
+    v.kernel = recording
+    try:
+        # two concurrent sub-threshold callers -> the coalescer merges
+        # (or, on an unlucky linger, dispatches each separately; either
+        # way every dispatch must be mesh-divisible)
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            futs = [pool.submit(v.verify, items[:4]),
+                    pool.submit(v.verify, items[4:])]
+            first, second = futs[0].result(), futs[1].result()
+    finally:
+        v.close()
+    assert first.tolist() == [True] * 4
+    assert second.tolist() == [True, False, True, True]  # tamper at 5
+    assert v.stats["coalesced_calls"] == 2
+    assert shapes, "no sharded dispatch recorded"
+    assert all(s % 4 == 0 for s in shapes), shapes
+
+
+def test_mesh_telemetry_surfaces():
+    """tm_verifier_mesh_devices reports the active mesh width and every
+    sharded dispatch lands in tm_mesh_dispatch_total +
+    tm_mesh_shard_occupancy (the new mesh catalog, also policed by the
+    metrics lint)."""
+    from tendermint_tpu import telemetry
+    from tendermint_tpu.models.verifier import BatchVerifier
+
+    pubs, msgs, sigs = signed_batch(16)
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    try:
+        v = BatchVerifier("jax", mesh="8")
+        d0 = telemetry.value("mesh_dispatch_total",
+                             {"kind": "verify"}) or 0
+        assert v.verify(list(zip(pubs, msgs, sigs))).all()
+        assert telemetry.value("verifier_mesh_devices") == 8
+        assert telemetry.value("mesh_dispatch_total",
+                               {"kind": "verify"}) == d0 + 1
+        occ = telemetry.value("mesh_shard_occupancy")
+        assert occ["count"] >= 1
+        # a full 16-item batch in a 16-wide bucket: occupancy 1.0
+        assert occ["sum"] >= 1.0
+    finally:
+        telemetry.set_enabled(was)
+
+
+def test_root_host_mesh_dispatch_bit_equality(monkeypatch):
+    """ops.merkle's host-facing roots (tx root, part-set root) route
+    through the sharded device kernel when a mesh is active, and the
+    bytes match the native/hashlib host path exactly. 100 leaves ->
+    the padded-128 shape the parametrized kernel tests already
+    compiled."""
+    from tendermint_tpu import telemetry
+
+    items = [rng.randbytes(rng.randrange(1, 40)) for _ in range(100)]
+    digests = [merkle.leaf_hash(it) for it in items]
+    want = merkle.root_host(items)  # TM_TPU_MESH=off in conftest: host
+
+    kern = sharded_merkle_root(make_mesh(8))
+    monkeypatch.setattr(merkle, "_mesh_state", (kern, 8))
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    try:
+        d0 = telemetry.value("mesh_dispatch_total",
+                             {"kind": "merkle"}) or 0
+        assert merkle.root_host(items) == want
+        # both digest-list and flat-blob forms take the mesh path
+        assert merkle.root_from_digests_host(digests) == want
+        assert merkle.root_from_digests_host(b"".join(digests)) == want
+        assert telemetry.value("mesh_dispatch_total",
+                               {"kind": "merkle"}) == d0 + 3
+        assert telemetry.value("merkle_roots_total",
+                               {"impl": "mesh"}) >= 3
+    finally:
+        telemetry.set_enabled(was)
+    # sub-threshold trees stay on host (no mesh dispatch)
+    small = [b"x"] * (merkle._MESH_MIN_LEAVES - 1)
+    assert merkle.root_host(small) == merkle.root_from_digests_host(
+        [merkle.leaf_hash(b"x")] * len(small))
+
+
+def test_merkle_mesh_env_resolution(monkeypatch):
+    """TM_TPU_MESH=N resolves the merkle mesh dispatch lazily through
+    the same parallel.mesh spec grammar the verifier uses (env wins,
+    power-of-two validation, loud overshoot)."""
+    items = [bytes([i]) * 11 for i in range(100)]
+    want = merkle.root_host(items)  # resolved off: host path
+
+    monkeypatch.setenv("TM_TPU_MESH", "8")
+    monkeypatch.setattr(merkle, "_mesh_state", None)
+    assert merkle.root_host(items) == want
+    kern, ndev = merkle._mesh_state
+    assert ndev == 8 and kern is not None
+
+    # overshooting the host fails loudly, same contract as the verifier
+    monkeypatch.setenv("TM_TPU_MESH", "64")
+    monkeypatch.setattr(merkle, "_mesh_state", None)
+    with pytest.raises(RuntimeError):
+        merkle.root_host(items)
+
+
 def test_fast_sync_window_verifies_through_mesh():
     """fast-sync's _sync_window drains its batched window through a
     mesh-sharded BatchVerifier injected via BlockExecutor — the node

@@ -124,10 +124,12 @@ def test_transposed_byte_roundtrip():
 def test_sign_kernel_interpret_matches_reference():
     """The sign kernel's enc(r*B) at n_windows=8 against the pure
     reference for crafted small nonces, AND the full native
-    phase1/phase2 pipeline against OpenSSL with the device step stubbed
-    by the reference ladder — together they pin everything the old
-    monolithic 64-window interpret run did, at ~1/6 the runtime:
-    kernel math (truncated, same body) + host nonce/finalize bytes."""
+    phase1/phase2 pipeline against a scalar RFC 8032 signer (OpenSSL
+    when installed, the pure oracle otherwise — identical bytes either
+    way) with the device step stubbed by the reference ladder —
+    together they pin everything the old monolithic 64-window interpret
+    run did, at ~1/6 the runtime: kernel math (truncated, same body) +
+    host nonce/finalize bytes."""
     # (a) kernel: small-r enc(r*B) differential
     rng = np.random.RandomState(5)
     rs = [int.from_bytes(rng.bytes(4), "little") % SCALAR_BOUND
@@ -145,9 +147,20 @@ def test_sign_kernel_interpret_matches_reference():
         assert out[i].tobytes() == want, i
 
     # (b) pipeline: native phase1 nonce + phase2 finalize around a
-    # reference-computed R, byte-identical to OpenSSL end to end
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
-        Ed25519PrivateKey
+    # reference-computed R, byte-identical to a conforming scalar
+    # signer end to end. Ed25519 signing is deterministic, so OpenSSL
+    # (when installed) and the pure RFC 8032 oracle produce the SAME
+    # bytes — the cross-check degrades gracefully on no-OpenSSL images
+    # instead of killing the whole kernel differential (cryptography
+    # has been optional tree-wide since PR 1).
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+            Ed25519PrivateKey
+
+        def _scalar_sign(seed, m):
+            return Ed25519PrivateKey.from_private_bytes(seed).sign(m)
+    except ImportError:
+        _scalar_sign = ref.sign
 
     seeds = [bytes([i + 1] * 32) for i in range(8)]
     msgs = [b"sign-batch-%d" % i * (i + 1) for i in range(8)]
@@ -171,5 +184,4 @@ def test_sign_kernel_interpret_matches_reference():
         ed25519._pallas_available = orig_pallas
         ed25519._sign_rb_pallas = orig_dev
     for seed, m, sig in zip(seeds, msgs, sigs):
-        want = Ed25519PrivateKey.from_private_bytes(seed).sign(m)
-        assert sig == want
+        assert sig == _scalar_sign(seed, m)
