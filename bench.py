@@ -424,39 +424,53 @@ def bench_chaos_json(path: str = "BENCH_chaos.json",
 
 def bench_p2p_json(path: str = "BENCH_p2p.json",
                    duration_s: float = 25.0) -> dict:
-    """Frame-plane trajectory point (ISSUE 3): the real-socket testnet
-    (4 OS processes, TCP + secret connections, 1,000-tx blocks) with the
-    burst frame plane ON vs OFF on the same host. Blocks/s from block
-    metas over the measured window; frames/burst and seal µs/frame come
-    from each arm's own /metrics scrape (tm_p2p_*), so the artifact
-    doubles as a live check of the new catalog."""
+    """Commit-path trajectory point on the PR 3 workload (ISSUE 7): the
+    real-socket testnet (4 OS processes, TCP + secret connections,
+    1,000-tx blocks) with the block hot-path PIPELINE on vs off on the
+    same host (burst frame plane at its default = on for both arms —
+    the pipeline_off arm IS the PR 3 burst-on configuration). Blocks/s
+    from block metas over the measured window; per-stage seconds,
+    overlap ratio and part-set build times come from each arm's own
+    /metrics scrape (tm_pipeline_*/tm_partset_*). Each arm's chain is
+    then REPLAYED SERIALLY in this process (bench_testnet._chain_parity)
+    — block bytes, part-set roots and the whole AppHash chain must be
+    bit-identical to the serial executor, or the bench raises."""
     import bench_testnet
 
     arms = {}
     for mode in ("off", "on"):
-        print(f"[bench] p2p socket arm burst={mode}...",
+        print(f"[bench] p2p socket arm pipeline={mode}...",
               file=sys.stderr, flush=True)
-        r = bench_testnet.run_socket(duration_s=duration_s, burst=mode)
+        r = bench_testnet.run_socket(duration_s=duration_s,
+                                     pipeline=mode, parity=True)
         arms[mode] = {
             "blocks_per_sec": r["blocks_per_sec"],
             "txs_per_sec": r["txs_per_sec"],
             "avg_txs_per_block": r["avg_txs_per_block"],
             "blocks": r["blocks"], "seconds": r["seconds"],
             **r.get("p2p", {}),
+            **({"pipeline": r["pipeline_metrics"]}
+               if r.get("pipeline_metrics") else {}),
+            "parity": r.get("parity", {}),
         }
     off, on = arms["off"]["blocks_per_sec"], arms["on"]["blocks_per_sec"]
+    pr3_baseline = 0.84  # burst-on blocks/s recorded by the PR 3 run
     doc = {
-        "metric": "p2p_socket_burst_commit_rate",
+        "metric": "p2p_socket_pipeline_commit_rate",
         "unit": "blocks/sec",
         "workload": "4-validator socket testnet, 1000-tx blocks, "
-                    "WS tx spammers, shared host",
+                    "WS tx spammers, shared host (PR 3 workload)",
         "source": "block metas over the measured window + each arm's "
-                  "tm_p2p_* /metrics scrape",
-        "knobs": {"TM_TPU_P2P_BURST": "off/on per arm",
+                  "tm_pipeline_*/tm_partset_*/tm_p2p_* scrape + serial "
+                  "replay parity audit",
+        "knobs": {"TM_TPU_PIPELINE": "off/on per arm",
+                  "TM_TPU_P2P_BURST": "default (auto=on) both arms",
                   "duration_s_per_arm": duration_s},
-        "burst_off": arms["off"],
-        "burst_on": arms["on"],
+        "pipeline_off": arms["off"],
+        "pipeline_on": arms["on"],
         "speedup": round(on / off, 2) if off else None,
+        "pr3_burst_on_baseline": pr3_baseline,
+        "speedup_vs_pr3_baseline": round(on / pr3_baseline, 2),
     }
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)

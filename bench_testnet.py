@@ -169,6 +169,99 @@ def _scrape_p2p_metrics(client) -> dict:
     return out
 
 
+def _scrape_pipeline_metrics(client) -> dict:
+    """tm_pipeline_* / tm_partset_* from one node's /metrics — per-stage
+    seconds, overlap ratio and precompute outcomes, so the bench arms
+    can attribute the win to specific pipeline stages."""
+    import re
+    text = client.call("metrics")["exposition"]
+    sums, counts, out = {}, {}, {}
+    for line in text.splitlines():
+        m = re.match(r'^(tm_(?:pipeline|partset)_[a-z_]+?)'
+                     r'(\{[^}]*\})? ([0-9.e+-]+)$', line)
+        if not m:
+            continue
+        name, labels, v = m.group(1), m.group(2) or "", float(m.group(3))
+        if name.endswith("_sum"):
+            sums[name[:-4] + labels] = v
+        elif name.endswith("_count"):
+            counts[name[:-6] + labels] = v
+        elif name.endswith("_total"):
+            out[name + labels] = int(v)
+    for key, s in sums.items():
+        n = counts.get(key, 0)
+        if n:
+            out[key + "_mean"] = round(s / n, 6)
+            out[key + "_count"] = int(n)
+    return out
+
+
+def _chain_parity(clients, part_size: int = 65536) -> dict:
+    """Bit-identity audit of a finished arm's chain, recomputed SERIALLY
+    in this (parent) process:
+
+    - every block's bytes re-encode to the stored header hash
+      (Block.from_obj -> to_bytes -> from_bytes round trip),
+    - every block's header.app_hash equals a fresh serial KVStore
+      replay of the txs so far (the AppHash chain is bit-identical to
+      what the non-pipelined executor would produce),
+    - the committed part-set roots equal both the serial Python split
+      and the native one-call builder, recomputed from the block bytes,
+    - all validators report the same height/app-hash frontier.
+
+    Raises AssertionError on any mismatch; returns a summary dict."""
+    from tendermint_tpu import native
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.ops import merkle
+    from tendermint_tpu.types.block import Block
+
+    h = min(c.call("status")["latest_block_height"] for c in clients)
+    first = 1
+    app = KVStoreApp()
+    app_hash = b""
+    partset_checks = 0
+    for height in range(first, h + 1):
+        r = clients[0].call("block", height=height)
+        meta, blk_obj = r["block_meta"], r["block"]
+        block = Block.from_obj(blk_obj)
+        if height > 1:
+            assert block.header.app_hash == app_hash, (
+                f"height {height}: header.app_hash diverged from "
+                f"serial replay")
+        data = block.to_bytes()
+        rt = Block.from_bytes(data)
+        assert rt.hash().hex() == meta["block_id"]["hash"], (
+            f"height {height}: block bytes do not re-encode to the "
+            f"stored header hash")
+        want_root = meta["block_id"]["parts"]["hash"]
+        chunks = [data[i:i + part_size]
+                  for i in range(0, len(data), part_size)] or [b""]
+        serial_root, _ = merkle.tree_proofs_host(chunks)
+        assert serial_root.hex() == want_root, (
+            f"height {height}: serial part-set root != committed root")
+        built = native.partset_build(data, part_size)
+        if built is not None:
+            assert built[0].hex() == want_root, (
+                f"height {height}: native part-set root != committed")
+        partset_checks += 1
+        for tx in block.data.txs:
+            app.deliver_tx(tx)
+        app_hash = app.commit()
+    frontiers = set()
+    for c in clients:
+        s = c.call("status")
+        if s["latest_block_height"] >= h:
+            b = c.call("block", height=h)
+            frontiers.add((b["block_meta"]["block_id"]["hash"],
+                           b["block"]["header"]["app_hash"]))
+    assert len(frontiers) == 1, f"validators disagree at {h}: {frontiers}"
+    return {"blocks_verified": h - first + 1,
+            "app_hash_chain_bit_identical": True,
+            "block_bytes_bit_identical": True,
+            "partset_roots_bit_identical": partset_checks,
+            "validators_agree_at": h}
+
+
 def _scrape_chaos_metrics(client) -> dict:
     """tm_chaos_faults_injected_total by kind from one node's /metrics
     — evidence the chaos plane actually fired in a TM_TPU_CHAOS run."""
@@ -185,7 +278,8 @@ def _scrape_chaos_metrics(client) -> dict:
 
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                duration_s: float = 25.0, burst: str = "",
-               chaos: str = "") -> dict:
+               chaos: str = "", pipeline: str = "",
+               parity: bool = False) -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -213,6 +307,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
     if chaos:  # chaos-plane link faults for every node (e.g.
         #        "drop=0.02,delay=0.05,seed=7"); "" inherits caller env
         env["TM_TPU_CHAOS"] = chaos
+    if pipeline:  # per-arm hot-path pipeline A/B (bench.py --p2p-json);
+        #          "" inherits whatever the caller exported
+        env["TM_TPU_PIPELINE"] = pipeline
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -272,9 +369,12 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             raise RuntimeError("socket testnet made no progress")
 
         def spam(tid):
-            # tm-bench shape: fire-and-forget casts over one persistent
-            # websocket (an HTTP round trip per tx caps injection at
-            # ~500 tx/s on this shared core — the chain outruns it)
+            # tm-bench shape, batched: fire-and-forget broadcast_tx_batch
+            # casts of 128 txs over one persistent websocket. Per-tx
+            # casts cost a server round trip each and capped injection
+            # at ~500 tx/s on this shared core; the pipelined commit
+            # path drains thousands per second, so the spammers must
+            # keep up for blocks to stay at the 1000-tx reap cap.
             from tendermint_tpu.rpc.client import WSClient
             ws = None
             i = 0
@@ -283,17 +383,18 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                     if ws is None:
                         ws = WSClient("127.0.0.1",
                                       base + 2 * (tid % n_vals) + 1)
-                    for _ in range(64):
-                        ws.cast("broadcast_tx_sync",
-                                tx=(b"s%d.%d=v" % (tid, i)).hex())
-                        i += 1
+                    for _ in range(4):
+                        ws.cast("broadcast_tx_batch",
+                                txs=[(b"s%d.%d=v" % (tid, i + k)).hex()
+                                     for k in range(128)])
+                        i += 128
                     sent[tid] = i  # per-thread slot: no racy +=
                     # periodic sync point: don't outrun the server,
                     # and back off while the backlog is deep enough
                     while not stop.is_set() and ws.call(
                             "num_unconfirmed_txs",
                             timeout=30.0)["n_txs"] > 3000:
-                        time.sleep(0.2)
+                        time.sleep(0.05)
                 except Exception:
                     if ws is not None:
                         try:
@@ -342,6 +443,15 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             p2p_metrics = _scrape_p2p_metrics(clients[0])
         except Exception:
             p2p_metrics = {}
+        try:
+            pipeline_metrics = _scrape_pipeline_metrics(clients[0])
+        except Exception:
+            pipeline_metrics = {}
+        parity_report = {}
+        if parity:
+            # bit-identity audit BEFORE teardown: serial replay of the
+            # whole chain in this process (AssertionError on mismatch)
+            parity_report = _chain_parity(clients)
         chaos_metrics = {}
         if chaos or (knobs.knob_raw("TM_TPU_CHAOS") or "off") \
                 .lower() not in knobs.FALSY:
@@ -368,7 +478,11 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             "txs_injected": sum(sent),
             "transport": "tcp sockets, 4 OS processes, secret conns",
             "burst": burst or "default",
+            "pipeline": pipeline or "default",
             "p2p": p2p_metrics,
+            **({"pipeline_metrics": pipeline_metrics}
+               if pipeline_metrics else {}),
+            **({"parity": parity_report} if parity_report else {}),
             **({"chaos": chaos, "chaos_faults": chaos_metrics}
                if chaos_metrics else {}),
         }

@@ -28,7 +28,7 @@ CHECKER_ID = "metrics"
 KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
-    "chaos", "mesh",
+    "chaos", "mesh", "pipeline", "partset",
 }
 
 INSTRUMENTED_MODULES = [
@@ -45,6 +45,8 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.types.events",       # tm_event_dropped_total
     "tendermint_tpu.rpc.core",
     "tendermint_tpu.chaos",              # tm_chaos_* fault/invariant plane
+    "tendermint_tpu.pipeline",           # tm_pipeline_* hot-path stages
+    "tendermint_tpu.types.part_set",     # tm_partset_build_seconds
 ]
 
 _LINE_RE = re.compile(

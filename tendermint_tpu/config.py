@@ -49,6 +49,11 @@ class BaseConfig:
     # send/recv routines byte-for-byte.
     p2p_burst: str = "auto"
     p2p_burst_max: int = 0  # 0 = burst.DEFAULT_MAX_PACKETS (64)
+    # pipelined block hot path (pipeline.py): native part-set build,
+    # streaming proposal gossip, overlapped finalize and group-commit
+    # persistence. auto|on|off; TM_TPU_PIPELINE wins over this. "off"
+    # restores the serial per-height code byte-for-byte.
+    pipeline: str = "auto"
     # chaos plane (chaos/): deterministic fault injection. "off" (the
     # default) is a zero-overhead no-op — p2p links stay on the
     # existing code paths byte-for-byte. Any other value is a link

@@ -23,7 +23,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
-from tendermint_tpu import telemetry
+from tendermint_tpu import pipeline, telemetry
 from tendermint_tpu.config import ConsensusConfig
 from tendermint_tpu.consensus.rstate import HeightVoteSet, RoundState, Step
 from tendermint_tpu.consensus.ticker import MockTicker, TimeoutInfo, TimeoutTicker
@@ -97,6 +97,17 @@ class ConsensusState:
         self.fatal_error = None
         self._processing = False
         self._stopped = False
+        # pipelined hot path (pipeline.py, TM_TPU_PIPELINE): resolved
+        # once at construction so a state machine never switches modes
+        # mid-height. off = the serial per-height code byte-for-byte.
+        self._pipeline = pipeline.resolve()
+        self._pre_lock = threading.Lock()
+        # next-proposal precompute handoff (worker -> propose step)
+        self._precomputed = None  #: guarded_by _pre_lock
+        # per-height stage accounting for tm_pipeline_overlap_ratio:
+        # consensus-thread-only (reset per height, read at finalize)
+        self._overlap_s = 0.0
+        self._serial_s = 0.0
         # telemetry timeline anchors (perf_counter stamps): when the
         # current round began, and the still-open step interval the next
         # _new_step closes as one Chrome-trace complete event
@@ -272,6 +283,8 @@ class ConsensusState:
             rs.last_commit = last_precommits
         rs.last_validators = state.last_validators
         self.state = state
+        self._overlap_s = 0.0   # per-height stage accounting restarts
+        self._serial_s = 0.0
         self._new_step()
 
     def _new_step(self) -> None:
@@ -421,13 +434,14 @@ class ConsensusState:
 
     def _decide_proposal(self, height: int, round_: int) -> None:
         rs = self.rs
+        parts_iter = None
         if rs.locked_block is not None:
             block, parts = rs.locked_block, rs.locked_block_parts
         else:
             made = self._create_proposal_block()
             if made is None:
                 return
-            block, parts = made
+            block, parts, parts_iter = made
 
         pol = rs.votes.pol_info()
         pol_round = pol.round if pol else -1
@@ -441,20 +455,40 @@ class ConsensusState:
                 self._log(f"error signing proposal: {e!r}")
             return
         # own proposal + parts ride the same queue as peer messages
-        self._enqueue_own({"type": "proposal",
-                           "proposal": proposal.to_obj()})
-        for i in range(parts.total):
-            part = parts.get_part(i)
-            self._enqueue_own({"type": "block_part", "height": height,
-                               "round": round_, "part": part.to_obj()})
-        self._broadcast({"type": "proposal", "proposal": proposal.to_obj()})
-        for i in range(parts.total):
-            self._broadcast({"type": "block_part", "height": height,
-                             "round": round_,
-                             "part": parts.get_part(i).to_obj()})
+        proposal_msg = {"type": "proposal", "proposal": proposal.to_obj()}
+        self._enqueue_own(proposal_msg)
+        if parts_iter is not None:
+            # streaming gossip (pipeline on): the proposal ships first
+            # (peers must be able to place the parts), then each part is
+            # enqueued + broadcast AS IT MATERIALIZES — gossip of part i
+            # overlaps materialization of part i+1, and each part is
+            # encoded exactly once instead of once per loop.
+            self._broadcast(proposal_msg)
+            with pipeline.stage_timer("gossip") as t:
+                for part in parts_iter:
+                    part_msg = {"type": "block_part", "height": height,
+                                "round": round_, "part": part.to_obj()}
+                    self._enqueue_own(part_msg)
+                    self._broadcast(part_msg)
+            self._serial_s += t.seconds
+            return
+        # serial path: today's two full loops, with the part message
+        # objects built ONCE (parts.get_part(i)/to_obj used to run twice
+        # per part — own-queue loop, then broadcast loop)
+        part_msgs = [{"type": "block_part", "height": height,
+                      "round": round_, "part": parts.get_part(i).to_obj()}
+                     for i in range(parts.total)]
+        for part_msg in part_msgs:
+            self._enqueue_own(part_msg)
+        self._broadcast(proposal_msg)
+        for part_msg in part_msgs:
+            self._broadcast(part_msg)
 
     def _create_proposal_block(self):
-        """consensus/state.go:854 createProposalBlock."""
+        """consensus/state.go:854 createProposalBlock. Returns
+        (block, parts, parts_iter): parts_iter is a streaming part
+        iterator when the pipeline built the set lazily (consume it to
+        completion before using `parts` as a full set), else None."""
         rs = self.rs
         if rs.height == 1:
             commit = None
@@ -468,12 +502,112 @@ class ConsensusState:
             return None
         txs = self.mempool.reap(self.config.max_block_size_txs)
         evidence = self.evidence_pool.pending_evidence()
+        part_size = \
+            self.state.consensus_params.block_gossip.block_part_size_bytes
+        if self._pipeline:
+            pre = self._take_precomputed(rs.height, txs, commit, evidence,
+                                         part_size)
+            if pre is not None:
+                return pre
         block = self.state.make_block(rs.height, txs, commit,
                                       time_ns=clock.now_ns(),
                                       evidence=evidence)
-        parts = block.make_part_set(
-            self.state.consensus_params.block_gossip.block_part_size_bytes)
-        return block, parts
+        if not self._pipeline:
+            parts = block.make_part_set(part_size)
+            return block, parts, None
+        with pipeline.stage_timer("serialize") as t_ser:
+            data = block.to_bytes()
+        with pipeline.stage_timer("partset") as t_ps:
+            from tendermint_tpu.types.part_set import PartSet
+            parts, parts_iter = PartSet.from_data_streaming(data, part_size)
+        self._serial_s += t_ser.seconds + t_ps.seconds
+        return block, parts, parts_iter
+
+    # ------------------------------------------------- pipeline: precompute
+
+    def _kick_precompute(self) -> None:
+        """Stage-3 overlap: while the committed height waits out the
+        commit timeout, build the NEXT height's proposal block + part
+        set on a worker thread. The result is used by
+        _create_proposal_block only when the fresh mempool reap, commit
+        and evidence still match exactly (anything changed -> discarded,
+        the serial build runs as before). Only kicked when this node
+        proposes round 0 of the next height."""
+        if self.priv_validator is None or self.replay_mode:
+            return
+        rs = self.rs
+        if rs.validators.proposer().address != self.priv_validator.address:
+            return
+        height, state = rs.height, self.state
+        if height == 1:
+            from tendermint_tpu.types.block import Commit
+            commit = Commit()
+        elif rs.last_commit is not None and \
+                rs.last_commit.has_two_thirds_majority():
+            # snapshot the commit ON the consensus thread: the VoteSet
+            # may gain straggler precommits while the worker runs (the
+            # propose-time compare catches that and discards)
+            commit = rs.last_commit.make_commit()
+        else:
+            return
+        part_size = \
+            state.consensus_params.block_gossip.block_part_size_bytes
+        max_txs = self.config.max_block_size_txs
+
+        def work():
+            try:
+                t0 = time.perf_counter()
+                txs = self.mempool.reap(max_txs)
+                evidence = self.evidence_pool.pending_evidence()
+                block = state.make_block(height, txs, commit,
+                                         time_ns=clock.now_ns(),
+                                         evidence=evidence)
+                data = block.to_bytes()
+                from tendermint_tpu.types.part_set import PartSet
+                parts = PartSet.from_data(data, part_size)
+                seconds = time.perf_counter() - t0
+                pipeline.observe_stage("precompute", seconds)
+                with self._pre_lock:
+                    cur = self._precomputed
+                    # a slow worker from an EARLIER height must not
+                    # clobber a fresher handoff (take() would discard
+                    # the stale one anyway, but the fresh one is the
+                    # one worth keeping)
+                    if cur is None or cur["height"] <= height:
+                        self._precomputed = {
+                            "height": height, "state": state,
+                            "part_size": part_size, "block": block,
+                            "parts": parts, "seconds": seconds}
+            except Exception:
+                pipeline.note_precompute("failed")
+
+        threading.Thread(target=work, daemon=True,
+                         name="cs-precompute").start()
+
+    def _take_precomputed(self, height: int, txs, commit, evidence,
+                          part_size: int):
+        """The precomputed (block, parts, None) when it exactly matches
+        what the serial build would produce NOW; else None (and the
+        stale entry is dropped). The block's header time is the
+        worker's stamp — a proposer clock reading a few hundred ms
+        early, carried verbatim in the gossiped block either way."""
+        with self._pre_lock:
+            pre, self._precomputed = self._precomputed, None
+        if pre is None:
+            return None
+        block = pre["block"]
+        from tendermint_tpu.types.block import EvidenceData
+        if (pre["height"] != height or pre["state"] is not self.state
+                or pre["part_size"] != part_size
+                or block.data.txs != list(txs)
+                or block.last_commit.to_bytes() != commit.to_bytes()
+                or block.evidence.to_obj()
+                != EvidenceData(list(evidence or [])).to_obj()):
+            pipeline.note_precompute("discarded")
+            return None
+        pipeline.note_precompute("used")
+        self._overlap_s += pre["seconds"]
+        return block, pre["parts"], None
 
     def _is_proposal_complete(self) -> bool:
         rs = self.rs
@@ -672,6 +806,9 @@ class ConsensusState:
             raise ConsensusFailure(f"+2/3 committed invalid block: {e}") from e
 
         from tendermint_tpu.utils import fail
+        if self._pipeline:
+            self._finalize_commit_pipelined(height, block, parts, pc)
+            return
         fail.fail_point("consensus.before_save_block")
         if self.block_store.height() < block.header.height:
             seen_commit = pc.make_commit()
@@ -699,6 +836,74 @@ class ConsensusState:
                               txs=len(block.data.txs))
 
         self._update_to_state(new_state)
+        self._schedule_round0()
+
+    def _finalize_commit_pipelined(self, height: int, block, parts,
+                                   pc) -> None:
+        """Group-commit finalize (pipeline on): every store write of the
+        height — save_block, save_abci_responses, save_state — STAGES
+        into one GroupCommit and flushes as one batch per db after
+        ApplyBlock, followed by the height's single WAL fsync (the
+        ENDHEIGHT marker). Crash ordering:
+
+        - before the flush: nothing of height H reached disk; the WAL
+          tail after ENDHEIGHT(H-1) holds every input of H, so catchup
+          replay re-decides and re-commits it (the app rebuilds via
+          handshake replay from the stores either way).
+        - between flush and ENDHEIGHT: stores hold H, the WAL has no
+          marker for it; wal_tail_for(H) fails loudly, catchup is
+          skipped (node.start logs), and the node proposes H+1 — no
+          committed state is lost and nothing replays twice.
+        - mid-flush: the block db commits strictly BEFORE the state db
+          (GroupCommit registration order), so a torn flush leaves
+          store_height == state_height + 1 — the handshake's
+          replay-forward case, never the fatal state-ahead-of-store.
+
+        Events fire only after the flush (GroupCommit.after_flush):
+        subscribers never observe a block the stores could still lose."""
+        rs = self.rs
+        from tendermint_tpu.utils import fail
+        fail.fail_point("consensus.before_save_block")
+        from tendermint_tpu.storage.block_store import BlockStore
+        group = pipeline.GroupCommit()
+        if self.block_store.height() < block.header.height:
+            seen_commit = pc.make_commit()
+            # staged view FIRST: block-db flush order precedes state-db
+            BlockStore(group.staged(self.block_store.db)).save_block(
+                block, parts, seen_commit)
+
+        block_id = BlockID(block.hash(), parts.header())
+        with pipeline.stage_timer("apply") as t_apply:
+            # pre_validated: _finalize_commit just ran validate_block on
+            # this exact (state, block) pair for the ConsensusFailure
+            # classification — don't verify the commit batch twice
+            new_state = self.block_exec.apply_block(
+                self.state.copy(), block_id, block, group=group,
+                pre_validated=True)
+        fail.fail_point("consensus.before_group_flush")
+        with pipeline.stage_timer("persist") as t_persist:
+            group.flush()
+            fail.fail_point("consensus.after_group_flush")
+            fail.fail_point("consensus.before_wal_end_height")
+            self.wal.save_end_height(height)  # the height's one fsync
+        fail.fail_point("consensus.after_wal_end_height")
+        fail.fail_point("consensus.after_apply_block")
+        self._serial_s += t_apply.seconds + t_persist.seconds
+
+        if self.decided_hook is not None:
+            self.decided_hook(block)
+
+        if telemetry.enabled() and not self.replay_mode:
+            _m_commits.inc()
+            _m_block_txs.observe(len(block.data.txs))
+            telemetry.instant("cs:finalize_commit", height=height,
+                              round=rs.commit_round,
+                              txs=len(block.data.txs))
+            pipeline.observe_overlap(self._overlap_s,
+                                     self._overlap_s + self._serial_s)
+
+        self._update_to_state(new_state)
+        self._kick_precompute()
         self._schedule_round0()
 
     # ------------------------------------------------------------- proposals

@@ -214,6 +214,60 @@ class Mempool:
             self.txs_available_hook()
         return res
 
+    def check_tx_batch(self, txs: List[bytes]) -> List[ResultCheckTx]:
+        """Admit a whole batch under ONE proxy_mtx acquisition with ONE
+        tx-WAL append — the RPC batch-ingest (rpc/core
+        broadcast_tx_batch) and gossip-receive path. Sustaining the
+        pipelined commit rate needs thousands of admissions per second;
+        per-call locking, WAL flushing and RPC round trips capped
+        injection far below it. Per-tx outcomes come back as
+        ResultCheckTx values aligned with `txs` (code 0 = admitted;
+        duplicates and a full mempool report non-zero codes instead of
+        raising, so one bad tx cannot poison the batch)."""
+        out: List[ResultCheckTx] = []
+        notify = False
+        wal_buf: List[bytes] = []
+        with self.proxy_mtx:
+            for tx in txs:
+                if self.size() >= self.max_size:
+                    _m_rejected.labels("full").inc()
+                    out.append(ResultCheckTx(
+                        code=1, log=f"mempool is full: {self.size()}"))
+                    continue
+                if tx in self._tx_elements:
+                    self.cache.push(tx)
+                    _m_rejected.labels("duplicate").inc()
+                    out.append(ResultCheckTx(code=1,
+                                             log="tx already in cache"))
+                    continue
+                if not self.cache.push(tx):
+                    _m_rejected.labels("duplicate").inc()
+                    out.append(ResultCheckTx(code=1,
+                                             log="tx already in cache"))
+                    continue
+                res = self.app_conn.check_tx(tx)
+                if res.ok:
+                    wal_buf.append(tx)
+                    self.counter += 1
+                    mtx = MempoolTx(self.counter, self.height, tx)
+                    self._tx_elements[tx] = self.txs.push_back(mtx)
+                    _m_added.inc()
+                else:
+                    self.cache.remove(tx)
+                    _m_rejected.labels("invalid").inc()
+                out.append(res)
+            if wal_buf:
+                if self._wal_file is not None:
+                    self._wal_file.write(b"".join(
+                        struct.pack(">I", len(tx)) + tx for tx in wal_buf))
+                    self._wal_file.flush()
+                if telemetry.enabled():
+                    _m_size.set(len(self.txs))
+                notify = self._mark_txs_available()
+        if notify:
+            self.txs_available_hook()
+        return out
+
     def _mark_txs_available(self) -> bool:
         """Arm the once-per-height notification; the CALLER fires the hook
         after releasing proxy_mtx (see module docstring)."""

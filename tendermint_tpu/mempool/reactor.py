@@ -66,9 +66,15 @@ class MempoolReactor(Reactor):
                 self.switch.stop_peer_for_error(
                     peer, ValueError("bad mempool message"))
             return
-        for tx_hex in txs:
+        raw = [bytes.fromhex(tx_hex) for tx_hex in txs]
+        if len(raw) > 1 and hasattr(self.mempool, "check_tx_batch"):
+            # one lock + one WAL append for the whole gossip batch;
+            # dups/overflow come back as result codes (normal noise)
+            self.mempool.check_tx_batch(raw)
+            return
+        for tx in raw:
             try:
-                self.mempool.check_tx(bytes.fromhex(tx_hex))
+                self.mempool.check_tx(tx)
             except (TxAlreadyInCache, MempoolFull):
                 pass  # dup/overflow: normal gossip noise
 

@@ -69,6 +69,13 @@ def _load():
         lib.tm_merkle_tree_proofs.argtypes = [u8p, u64p, ctypes.c_uint64,
                                               u8p, u8p]
         lib.tm_merkle_tree_proofs.restype = ctypes.c_uint64
+        try:
+            lib.tm_partset_build.argtypes = [u8p, ctypes.c_uint64,
+                                             ctypes.c_uint64, u8p, u8p]
+            lib.tm_partset_build.restype = ctypes.c_uint64
+        except AttributeError:
+            pass  # stale .so from before the part-set kernel: the
+            #       partset_build() wrapper reports unavailable
         lib.tm_ed25519_prepare.argtypes = [u8p, u8p, u8p, u64p,
                                            ctypes.c_uint64, u8p, u8p]
         try:
@@ -334,6 +341,35 @@ def ed25519_prepare(pk_bytes: bytes, sig_bytes: bytes,
     h = np.frombuffer(bytes(h_out), np.uint8).reshape(n, 32).copy()
     pre = np.frombuffer(bytes(pre_out), np.uint8)[:n].astype(bool).copy()
     return h, pre
+
+
+def partset_build(data: bytes, part_size: int):
+    """(root, [aunts per part]) for the part-size split of `data` —
+    split + leaf hashing + tree + every proof in ONE C call (the
+    part-set constructor's whole skeleton; types/part_set.py slices the
+    payloads itself, they are views of bytes it already holds). Empty
+    data yields one empty part, matching PartSet.from_data. None when
+    native is unavailable or the cached .so predates the kernel."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_partset_build"):
+        return None
+    if part_size <= 0:
+        raise ValueError("part_size must be positive")
+    n = max(1, -(-len(data) // part_size))
+    depth_max = max(1, (n - 1).bit_length()) if n > 1 else 1
+    buf = (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
+        data or b"\x00")
+    out_root = (ctypes.c_uint8 * 32)()
+    out_aunts = (ctypes.c_uint8 * (32 * depth_max * n))()
+    depth = lib.tm_partset_build(buf, len(data), part_size,
+                                 out_root, out_aunts)
+    raw = bytes(out_aunts)
+    proofs = []
+    for i in range(n):
+        base = 32 * depth * i  # C packs proofs at the actual depth
+        proofs.append([raw[base + 32 * j:base + 32 * (j + 1)]
+                       for j in range(depth)])
+    return bytes(out_root), proofs
 
 
 def merkle_tree_proofs(items: List[bytes]):

@@ -1241,6 +1241,35 @@ uint64_t tm_merkle_tree_proofs(const uint8_t *data,
   return depth;
 }
 
+// Burst part-set build (types/part_set.py PartSet.from_data): split
+// `data` (len bytes) into ceil(len/part_size) parts — ONE empty part
+// when len == 0, matching the Python `or [b""]` — then leaf-hash every
+// part and build the Merkle tree plus every part's proof in one call.
+// The Python path sliced chunks, packed a ctypes offset array and made
+// a separate tree call; here the proposer hands over the serialized
+// block once and gets the whole part-set skeleton back. out_aunts:
+// n_parts * depth * 32 bytes (n_parts and depth are fully determined
+// by len and part_size, so the caller allocates exactly). Returns the
+// tree depth.
+uint64_t tm_partset_build(const uint8_t *data, uint64_t len,
+                          uint64_t part_size, uint8_t *out_root,
+                          uint8_t *out_aunts) {
+  uint64_t n = part_size ? (len + part_size - 1) / part_size : 0;
+  if (n == 0) n = 1;  // empty data still yields one empty part
+  std::vector<uint64_t> offsets(n + 1);
+  for (uint64_t i = 0; i <= n; i++) {
+    uint64_t off = i * part_size;
+    offsets[i] = off < len ? off : len;
+  }
+  std::vector<std::vector<uint8_t>> levels;
+  std::vector<size_t> live;
+  uint64_t depth = build_tree(levels, live, data, offsets.data(), n);
+  for (uint64_t i = 0; i < n; i++)
+    extract_aunts(levels, live, depth, i, out_aunts + i * depth * 32);
+  final_hash(n, levels[depth].data(), out_root);
+  return depth;
+}
+
 // Ed25519 batch host-prep (ops/ed25519.py prepare_batch_bytes):
 // pk[n*32], sigs[n*64], msgs concatenated with bounds in offsets[n+1].
 // Writes h_out[n*32] = SHA512(R||A||M) mod L (little-endian) and

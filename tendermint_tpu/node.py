@@ -66,6 +66,11 @@ class Node:
             mode=getattr(config.base, "chaos", "off"),
             seed=getattr(config.base, "chaos_seed", 0))
 
+        # pipelined block hot path (env TM_TPU_PIPELINE wins inside
+        # resolve(); "off" restores the serial per-height code)
+        from tendermint_tpu import pipeline as _pipeline
+        _pipeline.configure(mode=getattr(config.base, "pipeline", "auto"))
+
         def db_path(name):
             if in_memory:
                 return None

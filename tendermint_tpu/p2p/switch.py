@@ -47,6 +47,22 @@ RECONNECT_MULTIPLIER = 2.0
 RECONNECT_MAX_S = 300.0
 
 
+def dial_tiebreak_keep_new(self_id: str, their_id: str,
+                           new_outbound: bool,
+                           existing_outbound: bool) -> bool:
+    """Simultaneous-dial survivor rule: both ends keep the connection
+    DIALED BY THE SMALLER NODE ID, so they independently agree on the
+    same single conn and never close each other's keeper. True when the
+    newly-registered duplicate should replace the existing peer entry.
+    Same-direction duplicates keep the existing conn (a plain double
+    dial, today's behavior)."""
+    if new_outbound == existing_outbound:
+        return False
+    new_dialer = self_id if new_outbound else their_id
+    old_dialer = self_id if existing_outbound else their_id
+    return new_dialer < old_dialer
+
+
 class SwitchError(Exception):
     pass
 
@@ -303,8 +319,28 @@ class Switch:
         peer.set_handlers(self._route, self._peer_error)
 
         if not self.peers.add(peer):
-            link.close()
-            raise SwitchError(f"duplicate peer {peer.id}")
+            # Simultaneous-dial tiebreak. When two peers dial each other
+            # at boot, each side ends up registering BOTH connections;
+            # rejecting the second unconditionally lets side A keep the
+            # conn side B closed and vice versa — both links dead, and
+            # the kept-inbound side (no dial_addr) never redials: the
+            # net partitions permanently at height 0. Both sides instead
+            # agree on ONE survivor: the connection DIALED BY THE SMALLER
+            # NODE ID. Same-direction duplicates (a double dial) keep the
+            # existing conn, exactly as before.
+            existing = self.peers.get(peer.id)
+            replaced = False
+            if existing is not None and \
+                    dial_tiebreak_keep_new(self.node_info.id, peer.id,
+                                           outbound, existing.outbound):
+                self.logger.info("simultaneous dial: replacing peer conn",
+                                 peer=peer.id, kept="out" if outbound
+                                 else "in")
+                self._remove_peer(existing, "simultaneous-dial tiebreak")
+                replaced = self.peers.add(peer)
+            if not replaced:
+                link.close()
+                raise SwitchError(f"duplicate peer {peer.id}")
         _m_peers.set(self.peers.size())
         with self._lock:
             # registry for join-on-stop: a recv thread that removes its

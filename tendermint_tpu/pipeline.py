@@ -1,0 +1,134 @@
+"""Pipelined block hot path — knob plane, stage metrics, group commit.
+
+ROADMAP item 2: everything between "block decided" and "next height
+proposable" used to run as sequential Python — serialize, split+hash the
+part set, gossip parts, ApplyBlock, then three separate store commits.
+This module is the shared plumbing the overlapped path hangs off:
+
+- `resolve()` — the TM_TPU_PIPELINE knob (env > config.base.pipeline >
+  default "auto" = on). "off" keeps every call site on today's serial
+  code byte-for-byte (test-asserted, tests/test_pipeline.py).
+- stage metrics — `tm_pipeline_stage_seconds{stage}` attributes the
+  per-height hot path (serialize | partset | gossip | apply | persist |
+  precompute), and `tm_pipeline_overlap_ratio` records, per commit, how
+  much of that stage time ran OFF the critical path (precompute overlap
+  + group-committed persistence vs. the serial sum).
+- `GroupCommit` — collects every store write a height produces
+  (save_block, save_abci_responses, save_state) into per-db
+  `StagedDB` overlays and flushes each as ONE batch, in registration
+  order (block store strictly before state store: the ABCI handshake
+  tolerates store==state+1 but not state>store), followed by the
+  height's single WAL fsync (the ENDHEIGHT marker, written by the
+  caller only after flush() returns — see consensus/state.py
+  _finalize_commit for the crash-ordering analysis).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List
+
+from tendermint_tpu import telemetry
+from tendermint_tpu.storage.db import KVStore, StagedDB
+from tendermint_tpu.utils import knobs
+
+_m_stage = telemetry.histogram(
+    "pipeline_stage_seconds",
+    "Per-height hot-path stage wall time (serialize | partset | gossip "
+    "| apply | persist | precompute)", ("stage",))
+_m_overlap = telemetry.histogram(
+    "pipeline_overlap_ratio",
+    "Per commit: fraction of stage time overlapped off the critical "
+    "path (0 = fully serial)")
+_m_precompute = telemetry.counter(
+    "pipeline_precompute_total",
+    "Next-proposal precompute outcomes", ("outcome",))
+
+# config.base.pipeline snapshot (node.py configure()); env wins inside
+# resolve(), so ConsensusStates built without a Node honor the knob too.
+_configured = "auto"
+
+
+def configure(mode: str = "auto") -> None:
+    global _configured
+    _configured = str(mode or "auto").strip().lower()
+
+
+def resolve() -> bool:
+    """True when the pipelined hot path is enabled. env TM_TPU_PIPELINE
+    > config.base.pipeline > default auto (= on). Any FALSY spelling
+    disables; auto/on/anything-else enables."""
+    v = knobs.knob_str("TM_TPU_PIPELINE", config=_configured,
+                       default="auto")
+    return v not in knobs.FALSY
+
+
+def observe_stage(stage: str, seconds: float) -> None:
+    if telemetry.enabled():
+        _m_stage.labels(stage).observe(seconds)
+
+
+def observe_overlap(overlapped_s: float, total_s: float) -> None:
+    if telemetry.enabled() and total_s > 0:
+        _m_overlap.observe(min(1.0, max(0.0, overlapped_s / total_s)))
+
+
+def note_precompute(outcome: str) -> None:
+    """outcome: used | discarded | failed."""
+    if telemetry.enabled():
+        _m_precompute.labels(outcome).inc()
+
+
+class stage_timer:
+    """`with stage_timer("apply"):` — one observation per block."""
+
+    def __init__(self, stage: str):
+        self.stage = stage
+        self.seconds = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self._t0
+        if exc[0] is None:
+            observe_stage(self.stage, self.seconds)
+        return False
+
+
+class GroupCommit:
+    """One height's store writes, staged and flushed as one batch per
+    db. Flush order is registration order — the caller must stage the
+    block store before the state store so a crash between the two db
+    commits leaves store_height == state_height + 1 (the handshake's
+    replay-forward case), never state ahead of store (fatal)."""
+
+    def __init__(self):
+        self._order: List[StagedDB] = []
+        self._by_id: dict[int, StagedDB] = {}
+        self._after: List[Callable[[], None]] = []
+
+    def staged(self, db: KVStore) -> StagedDB:
+        """The staging view for `db` (one per underlying store, however
+        many times it is requested — block and state stores sharing one
+        db flush as a single batch)."""
+        w = self._by_id.get(id(db))
+        if w is None:
+            w = StagedDB(db)
+            self._by_id[id(db)] = w
+            self._order.append(w)
+        return w
+
+    def after_flush(self, fn: Callable[[], None]) -> None:
+        """Defer a side effect (event fan-out) until the height's writes
+        are durable — subscribers must never observe a block the stores
+        could still lose to a crash."""
+        self._after.append(fn)
+
+    def flush(self) -> None:
+        for w in self._order:
+            w.flush_into_inner()
+        after, self._after = self._after, []
+        for fn in after:
+            fn()

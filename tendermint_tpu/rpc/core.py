@@ -92,6 +92,7 @@ class RPCCore:
             "num_unconfirmed_txs": self.num_unconfirmed_txs,
             "broadcast_tx_commit": self.broadcast_tx_commit,
             "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_batch": self.broadcast_tx_batch,
             "broadcast_tx_async": self.broadcast_tx_async,
             "abci_query": self.abci_query,
             "abci_info": self.abci_info,
@@ -330,6 +331,39 @@ class RPCCore:
         return jsonify({"code": res.code, "data": res.data,
                         "log": res.log,
                         "hash": hashlib.sha256(tx).digest()})
+
+    def broadcast_tx_batch(self, txs: list) -> dict:
+        """Batched CheckTx admission: one RPC round trip and one
+        mempool lock for the whole list (no reference equivalent — the
+        tm-bench-style per-tx casts cost a server round trip per tx,
+        capping injection far below the commit rate the pipelined block
+        path sustains). `txs` are hex strings; returns per-tx
+        {code, log} aligned with the input."""
+        if not isinstance(txs, list):
+            raise RPCError(-32602, "txs must be a list of hex strings")
+        try:
+            raw = [bytes.fromhex(t[2:] if t.startswith("0x") else t)
+                   for t in txs]
+        except (ValueError, AttributeError) as e:
+            raise RPCError(-32602, f"bad tx hex: {e}") from e
+        mp = self.env.mempool
+        if hasattr(mp, "check_tx_batch"):
+            results = mp.check_tx_batch(raw)
+        else:  # mock/minimal mempools: per-tx path, errors as codes
+            from tendermint_tpu.abci.types import ResultCheckTx
+            from tendermint_tpu.mempool import (MempoolFull,
+                                                TxAlreadyInCache)
+            results = []
+            for tx in raw:
+                try:
+                    results.append(mp.check_tx(tx))
+                except TxAlreadyInCache:
+                    results.append(ResultCheckTx(
+                        code=1, log="tx already in cache"))
+                except MempoolFull as e:
+                    results.append(ResultCheckTx(code=1, log=str(e)))
+        return jsonify({"results": [{"code": r.code, "log": r.log}
+                                    for r in results]})
 
     def broadcast_tx_commit(self, tx: bytes, timeout: float = 60.0) -> dict:
         """CheckTx then wait for the tx to land in a block

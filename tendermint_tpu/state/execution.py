@@ -149,17 +149,37 @@ class BlockExecutor:
                        trust_last_commit=trust_last_commit)
 
     def apply_block(self, state: State, block_id: BlockID,
-                    block: Block, trust_last_commit: bool = False) -> State:
+                    block: Block, trust_last_commit: bool = False,
+                    group=None, pre_validated: bool = False) -> State:
         """state/execution.go:71-119. Returns the new State; raises
         BlockValidationError on an invalid block. `trust_last_commit`:
-        see validation.validate_block (fast-sync pre-verified path)."""
+        see validation.validate_block (fast-sync pre-verified path).
+
+        `group` (a pipeline.GroupCommit) switches the height's store
+        writes into group-commit mode: save_abci_responses/save_state
+        STAGE into the group instead of committing per call (the caller
+        flushes once after this returns), and event fan-out is deferred
+        to after that flush — subscribers must not observe a block the
+        stores could still lose to a crash. The app Commit / mempool
+        ordering is untouched.
+
+        `pre_validated=True` skips re-validation for a caller that just
+        ran validate_block on the SAME (state, block) pair — the
+        pipelined finalize, which validates once for the consensus
+        failure classification and must not pay the commit-signature
+        batch twice per height."""
         from tendermint_tpu.utils import fail
-        self.validate_block(state, block,
-                            trust_last_commit=trust_last_commit)
+        if not pre_validated:
+            self.validate_block(state, block,
+                                trust_last_commit=trust_last_commit)
         responses = exec_block_on_app(self.app_conn, block, state.validators)
         fail.fail_point("execution.after_exec_block")
-        if self.state_store is not None:
-            self.state_store.save_abci_responses(
+        state_store = self.state_store
+        if group is not None and state_store is not None:
+            from tendermint_tpu.storage.state_store import StateStore
+            state_store = StateStore(group.staged(self.state_store.db))
+        if state_store is not None:
+            state_store.save_abci_responses(
                 block.header.height, responses.to_obj())
         fail.fail_point("execution.after_save_abci_responses")
         new_state = update_state(state, block_id, block, responses)
@@ -176,12 +196,17 @@ class BlockExecutor:
 
         fail.fail_point("execution.after_app_commit")
         new_state.app_hash = app_hash
-        if self.state_store is not None:
-            self.state_store.save(new_state)
+        if state_store is not None:
+            state_store.save(new_state)
         fail.fail_point("execution.after_save_state")
         self.evidence_pool.update(block, new_state)
         if self.event_bus is not None:
-            fire_events(self.event_bus, block, block_id, responses)
+            if group is None:
+                fire_events(self.event_bus, block, block_id, responses)
+            else:
+                bus = self.event_bus
+                group.after_flush(
+                    lambda: fire_events(bus, block, block_id, responses))
         return new_state
 
     def exec_commit_block(self, block: Block) -> bytes:

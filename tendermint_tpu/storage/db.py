@@ -139,6 +139,65 @@ class SQLiteDB:
         self._local.con = None
 
 
+class StagedDB:
+    """Write-staging view over a KVStore — the group-commit substrate
+    (tendermint_tpu/pipeline.py). set/set_batch/delete collect into an
+    in-memory overlay; get/iterate serve read-your-writes; nothing
+    touches the inner store until flush_into_inner() applies the whole
+    overlay as ONE set_batch (one transaction / one commit for every
+    write a height staged, instead of a commit per store call).
+
+    Single-writer by design: the consensus drain loop is the only
+    staging writer, and the overlay dict is only merged into reads —
+    concurrent readers (RPC, gossip catchup) going through the INNER
+    store simply miss not-yet-flushed rows, exactly as they would have
+    mid-save before group commit existed."""
+
+    def __init__(self, inner: KVStore):
+        self.inner = inner
+        self.staged: dict[bytes, Optional[bytes]] = {}  # None = deleted
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        k = bytes(key)
+        if k in self.staged:
+            return self.staged[k]
+        return self.inner.get(k)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self.staged[bytes(key)] = bytes(value)
+
+    def set_batch(self, pairs: Sequence[tuple[bytes, bytes]]) -> None:
+        for k, v in pairs:
+            self.staged[bytes(k)] = bytes(v)
+
+    def delete(self, key: bytes) -> None:
+        self.staged[bytes(key)] = None
+
+    def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
+        over = {k: v for k, v in self.staged.items() if k.startswith(prefix)}
+        for k, v in self.inner.iterate(prefix):
+            if k in over:
+                continue  # staged value (or deletion) shadows the row
+            over[k] = v
+        for k in sorted(over):
+            if over[k] is not None:
+                yield k, over[k]
+
+    def close(self) -> None:
+        pass  # view only; the inner store's owner closes it
+
+    def flush_into_inner(self) -> None:
+        """Apply the overlay to the inner store: one set_batch for every
+        staged write, then any staged deletions. Clears the overlay."""
+        sets = [(k, v) for k, v in self.staged.items() if v is not None]
+        dels = [k for k, v in self.staged.items() if v is None]
+        if sets:
+            self.inner.set_batch(sets)
+        for k in dels:
+            self.inner.delete(k)
+        self.staged.clear()
+
+
 def open_db(path: Optional[str]) -> KVStore:
     """None/'' or ':memory:' -> MemDB; otherwise SQLite at path."""
     if not path or path == ":memory:":

@@ -385,8 +385,23 @@ class Block:
         return blk
 
     def make_part_set(self, part_size: int):
+        # cached KEYED ON (HEADER HASH, PART SIZE), the same
+        # invalidation discipline as to_bytes above: block_id() used to
+        # re-serialize, re-split and re-hash the whole block on every
+        # call. A header mutation changes the header hash (its
+        # __setattr__ drops the cached hash), which misses this key and
+        # rebuilds — a tampered block can never serve a stale part set.
+        # Unfilled headers (hash() == b"") are never cached: their hash
+        # cannot witness further mutation.
         from tendermint_tpu.types.part_set import PartSet
-        return PartSet.from_data(self.to_bytes(), part_size)
+        hh = self.header.hash()
+        if hh and self.__dict__.get("_partset_key") == (hh, part_size):
+            return self.__dict__["_partset"]
+        ps = PartSet.from_data(self.to_bytes(), part_size)
+        if hh:
+            self.__dict__["_partset"] = ps
+            self.__dict__["_partset_key"] = (hh, part_size)
+        return ps
 
     def block_id(self, part_size: int) -> BlockID:
         ps = self.make_part_set(part_size)

@@ -123,14 +123,25 @@ class PrivKey:
         return pk
 
     def sign(self, msg: bytes) -> bytes:
-        # OpenSSL signs in ~30us vs ~5ms for the pure-Python oracle,
-        # bit-identical output (Ed25519 signing is deterministic);
-        # the handle is cached per instance, same rationale as pubkey
+        # OpenSSL signs in ~30us, bit-identical output (Ed25519 signing
+        # is deterministic); the handle is cached per instance, same
+        # rationale as pubkey. Without OpenSSL the table oracle signs in
+        # ~4ms vs ~50ms for the two fresh ladders of ed25519_ref.sign —
+        # per-vote signing latency sits on the consensus critical path,
+        # so the secret expansion is cached per instance too (the
+        # expansion itself is one ladder; utils/ed25519_fast holds no
+        # secret state).
         k = self.__dict__.get("_osslk")
         if k is None:
             cls = _openssl_key_class()
             if cls is None:
-                return _ref.sign(self.seed, msg)
+                exp = self.__dict__.get("_exp")
+                if exp is None:
+                    a, prefix = _ref.secret_expand(self.seed)
+                    exp = (a, prefix, self.pubkey.ed25519)
+                    self.__dict__["_exp"] = exp
+                from tendermint_tpu.utils import ed25519_fast
+                return ed25519_fast.sign_expanded(*exp, msg)
             k = cls.from_private_bytes(self.seed)
             self.__dict__["_osslk"] = k
         return k.sign(msg)
@@ -271,6 +282,15 @@ def verify_any(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         out = _openssl_verify(pubkey, msg, sig)
         if out is not None:
             return out
+        # table upgrade for RESIDENT keys only: steady-state consensus
+        # verifies the same validator keys vote after vote (tables get
+        # built by the first >= _HOST_TABLE_MIN batch, verify_many
+        # below), so the scalar per-vote path runs at table speed
+        # (~5ms) instead of two fresh ladders (~25ms) — without letting
+        # one-off interactive verifies populate the LRU
+        from tendermint_tpu.utils import ed25519_fast
+        if ed25519_fast.has_table(pubkey):
+            return ed25519_fast.verify(pubkey, msg, sig)
         return _ref.verify(pubkey, msg, sig)
     if len(pubkey) == 33 and pubkey[0] in (2, 3):
         return Secp256k1PubKey(pubkey).verify(msg, sig)

@@ -4,15 +4,30 @@ Capability parity with types/part_set.go: NewPartSetFromData (:94),
 AddPart with proof verification (:187-203). Proofs use the ops/merkle.py
 spec; part hashing of the (large, fixed-size) part payloads is the
 device-batched SHA-256 path when building full sets.
+
+Construction is pipelined (ROADMAP item 2) behind TM_TPU_PIPELINE: the
+native `tm_partset_build` kernel does split + leaf hashing + tree +
+every proof in one C call (native/hostops.cpp), and
+`from_data_streaming` yields parts one at a time so the proposer can
+gossip early parts while later ones are still being materialized.
+Either way the parts, proofs and root are byte-identical to the serial
+Python split (tests/test_pipeline.py parity matrix).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import List, Optional
+import time
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
 
+from tendermint_tpu import telemetry
 from tendermint_tpu.ops import merkle
 from tendermint_tpu.types.block import PartSetHeader
+
+_m_build = telemetry.histogram(
+    "partset_build_seconds",
+    "Full part-set construction (split + leaf hash + tree + proofs) "
+    "by implementation", ("impl",))
 
 
 @dataclass
@@ -31,6 +46,31 @@ class Part:
                    [bytes.fromhex(a) for a in o["proof"]])
 
 
+def _build_skeleton(data: bytes, part_size: int):
+    """(n_parts, root, proofs, impl): the Merkle skeleton of the part
+    split. One native C call when the pipeline is enabled and the
+    kernel is available; otherwise the serial Python split feeding the
+    (native-backed) whole-tree proof builder — bit-identical output."""
+    from tendermint_tpu import pipeline
+    t0 = time.perf_counter()
+    n = max(1, -(-len(data) // part_size))
+    built = None
+    if pipeline.resolve():
+        from tendermint_tpu import native
+        built = native.partset_build(data, part_size)
+    if built is not None:
+        root, proofs = built
+        impl = "native"
+    else:
+        chunks = [data[i:i + part_size]
+                  for i in range(0, len(data), part_size)] or [b""]
+        root, proofs = merkle.tree_proofs_host(chunks)
+        impl = "python"
+    if telemetry.enabled():
+        _m_build.labels(impl).observe(time.perf_counter() - t0)
+    return n, root, proofs, impl
+
+
 class PartSet:
     def __init__(self, total: int, root: bytes):
         self.total = total
@@ -41,14 +81,38 @@ class PartSet:
 
     @classmethod
     def from_data(cls, data: bytes, part_size: int) -> "PartSet":
-        chunks = [data[i:i + part_size] for i in range(0, len(data), part_size)] or [b""]
-        root, proofs = merkle.tree_proofs_host(chunks)
-        ps = cls(len(chunks), root)
-        for i, c in enumerate(chunks):
-            ps.parts[i] = Part(i, c, proofs[i])
-        ps.count = len(chunks)
+        n, root, proofs, _ = _build_skeleton(data, part_size)
+        ps = cls(n, root)
+        for i in range(n):
+            ps.parts[i] = Part(i, data[i * part_size:(i + 1) * part_size],
+                               proofs[i])
+        ps.count = n
         ps._size = len(data)
         return ps
+
+    @classmethod
+    def from_data_streaming(cls, data: bytes, part_size: int
+                            ) -> Tuple["PartSet", Iterator[Part]]:
+        """(ps, parts_iter) — the set's header (total + root) is usable
+        immediately (the proposal must carry it before any part ships),
+        while the Part objects materialize lazily as the iterator is
+        consumed, each added into `ps` as it is yielded. The proposer
+        interleaves gossip of part i with materialization of part i+1
+        instead of building the whole list first; fully consuming the
+        iterator leaves `ps` byte-identical to from_data()."""
+        n, root, proofs, _ = _build_skeleton(data, part_size)
+        ps = cls(n, root)
+
+        def gen() -> Iterator[Part]:
+            for i in range(n):
+                part = Part(i, data[i * part_size:(i + 1) * part_size],
+                            proofs[i])
+                ps.parts[i] = part
+                ps.count += 1
+                ps._size += len(part.payload)
+                yield part
+
+        return ps, gen()
 
     @classmethod
     def from_header(cls, header: PartSetHeader) -> "PartSet":

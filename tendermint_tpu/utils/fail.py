@@ -39,9 +39,29 @@ _callback = None  # test hook: replaces os._exit when the target index hits
 _target = None    # programmatic FAIL_TEST_INDEX (env wins when both set)
 _armed: dict = {}  # name -> one-shot callback
 
-# The commit-critical fail points, in the order one commit passes them
-# (consensus/state.py _finalize_commit -> state/execution.py apply_block).
+# The commit-critical fail points, in the order one PIPELINED commit
+# passes them (the TM_TPU_PIPELINE default: consensus/state.py
+# _finalize_commit_pipelined -> state/execution.py apply_block with the
+# store writes staged, then the group flush + the height's single WAL
+# fsync). before/after_group_flush bracket the batch write; they never
+# fire on the serial path.
 COMMIT_POINTS = (
+    "consensus.before_save_block",
+    "execution.after_exec_block",
+    "execution.after_save_abci_responses",
+    "execution.after_app_commit",
+    "execution.after_save_state",
+    "consensus.before_group_flush",
+    "consensus.after_group_flush",
+    "consensus.before_wal_end_height",
+    "consensus.after_wal_end_height",
+    "consensus.after_apply_block",
+)
+
+# The same points in SERIAL order (TM_TPU_PIPELINE=off): save_block
+# commits immediately, ENDHEIGHT is fsynced BEFORE ApplyBlock, and the
+# group-flush brackets do not exist on this path.
+SERIAL_COMMIT_POINTS = (
     "consensus.before_save_block",
     "consensus.before_wal_end_height",
     "consensus.after_wal_end_height",

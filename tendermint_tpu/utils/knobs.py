@@ -94,6 +94,12 @@ CATALOG: tuple[Knob, ...] = (
     Knob("TM_TPU_P2P_BURST", "spec", "auto", "base.p2p_burst",
          "Burst frame plane: off|on|auto|<max packets per burst>.",
          "p2p/conn/burst.py"),
+    # -- block hot-path pipeline -------------------------------------------
+    Knob("TM_TPU_PIPELINE", "str", "auto", "base.pipeline",
+         "Pipelined per-height hot path (native part-set build, "
+         "streaming proposal gossip, overlapped finalize, group-commit "
+         "persistence): auto|on|off. off = serial path byte-for-byte.",
+         "pipeline.py"),
     # -- telemetry ---------------------------------------------------------
     Knob("TM_TPU_TELEMETRY", "bool", "unset (config decides, on)",
          "base.telemetry",

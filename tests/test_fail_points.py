@@ -81,7 +81,8 @@ def _drain(node, max_ticks=200):
 def test_commit_points_fire_in_catalog_order(tmp_path):
     """One commit passes every COMMIT_POINTS entry, in order — the
     catalog is what schedules and docs reference, so it must match the
-    code path exactly."""
+    code path exactly. COMMIT_POINTS documents the default (pipelined)
+    order; the serial escape hatch is pinned separately below."""
     seen = []
     for name in fail.COMMIT_POINTS:
         fail.arm(name, seen.append)
@@ -92,6 +93,25 @@ def test_commit_points_fire_in_catalog_order(tmp_path):
     _commit_to(node, 1)
     node.stop()
     assert seen == list(fail.COMMIT_POINTS)
+
+
+def test_commit_points_serial_order_with_pipeline_off(tmp_path,
+                                                      monkeypatch):
+    """TM_TPU_PIPELINE=off restores the serial commit path: save_block
+    commits immediately, ENDHEIGHT fsyncs BEFORE ApplyBlock, and the
+    group-flush brackets never fire (SERIAL_COMMIT_POINTS order)."""
+    monkeypatch.setenv("TM_TPU_PIPELINE", "off")
+    seen = []
+    for name in fail.COMMIT_POINTS:
+        fail.arm(name, seen.append)
+    gen, key = _gen("fp-serial-order")
+    node = _make_node(str(tmp_path), gen, key)
+    node.start()
+    _inject(node, WAVE_A)
+    _commit_to(node, 1)
+    node.stop()
+    fail.disarm_all()
+    assert seen == list(fail.SERIAL_COMMIT_POINTS)
 
 
 def test_set_target_and_callback_and_clear():
@@ -121,16 +141,23 @@ def test_arm_is_one_shot_and_name_scoped():
 
 # ------------------------------------------------ crash-index sweep --
 
-def test_crash_at_every_index_recovers_same_apphash(tmp_path):
-    """For EVERY commit-critical fail point: run two heights clean,
-    crash the third height's commit at that index, restart from disk,
-    and require the recovered node to reach the control run's height
-    with the IDENTICAL AppHash — WAL catchup + ABCI handshake replay
-    must reconcile whatever prefix of the commit reached disk."""
+def test_crash_at_every_index_recovers_same_apphash(tmp_path,
+                                                    monkeypatch):
+    """For EVERY commit-critical fail point of the PIPELINED path (the
+    default — group-commit staging, batch flush, post-flush ENDHEIGHT):
+    run two heights clean, crash the third height's commit at that
+    index, restart from disk, and require the recovered node to reach
+    the control run's height with the IDENTICAL AppHash — WAL catchup +
+    ABCI handshake replay must reconcile whatever prefix of the commit
+    reached disk. The control runs with TM_TPU_PIPELINE=off, so the
+    sweep simultaneously pins pipelined recovery AGAINST the serial
+    path's AppHash (bit-identical across modes)."""
     target = 4
     gen, key = _gen("fp-sweep")
 
+    monkeypatch.setenv("TM_TPU_PIPELINE", "off")
     control = _make_node(str(tmp_path / "control"), gen, key)
+    monkeypatch.delenv("TM_TPU_PIPELINE")
     control.start()
     _inject(control, WAVE_A)
     _commit_to(control, 2)

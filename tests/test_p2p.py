@@ -398,3 +398,74 @@ def test_bp_peer_slow_after_stall(monkeypatch):
     assert not p.is_slow()           # fast while transferring
     _time.sleep(0.7)                 # stall past grace + window
     assert p.is_slow()
+
+
+def test_dial_tiebreak_rule_is_symmetric():
+    """Both ends must independently pick the SAME surviving connection
+    (the one dialed by the smaller node id), else a simultaneous dial
+    leaves each side holding the conn the other side closed — a
+    permanently dead link at boot (no dial_addr on the kept-inbound
+    side means no redial, and a 3-node net then stalls at height 0)."""
+    from tendermint_tpu.p2p.switch import dial_tiebreak_keep_new
+    a, b = "aa" * 20, "bb" * 20
+    # on A (id a, smaller): A-dialed conn is outbound. It must win
+    # whether it registers first (inbound dup rejected) or second
+    # (replaces the inbound).
+    assert dial_tiebreak_keep_new(a, b, True, False)       # new=A-dialed
+    assert not dial_tiebreak_keep_new(a, b, False, True)   # new=B-dialed
+    # on B (id b, larger): the A-dialed conn is INBOUND and must win.
+    assert dial_tiebreak_keep_new(b, a, False, True)
+    assert not dial_tiebreak_keep_new(b, a, True, False)
+    # same-direction duplicates keep the existing conn (double dial)
+    assert not dial_tiebreak_keep_new(a, b, True, True)
+    assert not dial_tiebreak_keep_new(b, a, False, False)
+
+
+def test_simultaneous_dial_converges_to_one_live_link():
+    """Two switches dial each other at the same moment; after the
+    tiebreak each side must hold exactly ONE peer entry and the link
+    must actually CARRY TRAFFIC both ways (the pre-fix failure kept a
+    dead socket registered on both sides)."""
+    r1 = EchoReactor("echo", 0x10, echo=True)
+    r2 = EchoReactor("echo", 0x10, echo=False)
+    sw1 = make_switch(seed=b"\x11" * 32)
+    sw2 = make_switch(seed=b"\x12" * 32)
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start(); sw2.start()
+    addr1 = sw1.listen("127.0.0.1", 0)
+    addr2 = sw2.listen("127.0.0.1", 0)
+    errs = []
+
+    def dial(sw, addr):
+        try:
+            sw.dial_peer(addr)
+        except SwitchError:
+            pass  # the losing conn of the tiebreak
+        except Exception as e:  # pragma: no cover - diagnostics
+            errs.append(e)
+
+    t1 = threading.Thread(target=dial, args=(sw1, addr2))
+    t2 = threading.Thread(target=dial, args=(sw2, addr1))
+    t1.start(); t2.start()
+    t1.join(15); t2.join(15)
+    assert not errs
+    assert wait_for(lambda: sw1.peers.size() == 1 and
+                    sw2.peers.size() == 1, timeout=10)
+    # the surviving link is LIVE end to end: a message from sw2 reaches
+    # sw1's echo reactor and the echo comes back
+    deadline = time.monotonic() + 10
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        for p in sw2.peers.list():
+            p.try_send(0x10, b"tiebreak-ping")
+        ok = any(m == b"echo:tiebreak-ping" for _, m in r2.received)
+        if not ok:
+            time.sleep(0.1)
+    assert ok, "surviving connection does not carry traffic"
+    # both sides kept the SAME conn: the one dialed by the smaller id
+    p1, p2 = sw1.peers.list()[0], sw2.peers.list()[0]
+    small_first = sw1.node_info.id < sw2.node_info.id
+    assert p1.outbound == small_first
+    assert p2.outbound == (not small_first)
+    sw1.stop(); sw2.stop()
