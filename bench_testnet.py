@@ -279,7 +279,7 @@ def _scrape_chaos_metrics(client) -> dict:
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                duration_s: float = 25.0, burst: str = "",
                chaos: str = "", pipeline: str = "",
-               parity: bool = False) -> dict:
+               parity: bool = False, trace: str = "") -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -310,6 +310,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
     if pipeline:  # per-arm hot-path pipeline A/B (bench.py --p2p-json);
         #          "" inherits whatever the caller exported
         env["TM_TPU_PIPELINE"] = pipeline
+    if trace:  # causal tracing plane for every node (bench.py
+        #       --trace-json); "" inherits whatever the caller exported
+        env["TM_TPU_TRACE"] = trace
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -447,6 +450,19 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             pipeline_metrics = _scrape_pipeline_metrics(clients[0])
         except Exception:
             pipeline_metrics = {}
+        timelines = []
+        if trace:
+            # every node's span ring BEFORE teardown: the measured
+            # window's heights plus all link spans (clock alignment);
+            # bench.py merges them into the cluster timeline
+            for c in clients:
+                try:
+                    timelines.append(c.call(
+                        "dump_height_timeline",
+                        min_height=h0 + 1, max_height=h1))
+                except (OSError, RPCClientError) as e:
+                    print(f"[bench] timeline fetch failed: {e!r}",
+                          file=sys.stderr)
         parity_report = {}
         if parity:
             # bit-identity audit BEFORE teardown: serial replay of the
@@ -485,6 +501,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             **({"parity": parity_report} if parity_report else {}),
             **({"chaos": chaos, "chaos_faults": chaos_metrics}
                if chaos_metrics else {}),
+            **({"timelines": timelines} if timelines else {}),
         }
     except BaseException:
         # keep the net tree and surface log tails: the node logs are

@@ -16,6 +16,7 @@ Rules (unchanged from the PR-1 lint):
 
 from __future__ import annotations
 
+import os
 import re
 from typing import List
 
@@ -28,7 +29,7 @@ CHECKER_ID = "metrics"
 KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
-    "chaos", "mesh", "pipeline", "partset",
+    "chaos", "mesh", "pipeline", "partset", "trace",
 }
 
 INSTRUMENTED_MODULES = [
@@ -47,7 +48,18 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.chaos",              # tm_chaos_* fault/invariant plane
     "tendermint_tpu.pipeline",           # tm_pipeline_* hot-path stages
     "tendermint_tpu.types.part_set",     # tm_partset_build_seconds
+    "tendermint_tpu.telemetry.trace",    # tm_trace_events_dropped_total
 ]
+
+# Causal span names follow the same closed-catalog discipline as metric
+# families: every literal name at a span/point call site must be
+# declared in telemetry.causal.SPAN_CATALOG, or dashboards and the
+# trace merger silently miss it. The regex covers the three call
+# shapes in the tree: causal.span/point/record(...) and the consensus
+# state machine's _cspan/_cpoint helpers.
+_SPAN_NAME_RE = re.compile(
+    r'(?:causal\.(?:span|point|record)|_cspan|_cpoint)\(\s*'
+    r'[\'"]([a-z0-9_.]+)[\'"]')
 
 _LINE_RE = re.compile(
     r'^[a-z_][a-z0-9_]*(\{[a-z0-9_]+="(?:[^"\\]|\\.)*"'
@@ -101,8 +113,44 @@ def run() -> List[Finding]:
         if not _LINE_RE.match(line):
             problem(f"unparseable exposition line: {line!r}")
 
+    findings.extend(span_findings())
+
     run.summary = (f"{len(names)} families, {len(exposed)} "
                    f"exposed series names")
+    return findings
+
+
+def span_findings(root: str = "") -> List[Finding]:
+    """Lint causal span-name call sites against SPAN_CATALOG. `root`
+    defaults to the installed tendermint_tpu package tree (tests point
+    it at fixture dirs)."""
+    from tendermint_tpu.telemetry.causal import SPAN_CATALOG
+    if not root:
+        import tendermint_tpu
+        pkg = os.path.dirname(os.path.abspath(tendermint_tpu.__file__))
+        try:
+            root = os.path.relpath(pkg)
+        except ValueError:  # different drive (windows): keep absolute
+            root = pkg
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                continue
+            for i, line in enumerate(lines, 1):
+                for m in _SPAN_NAME_RE.finditer(line):
+                    if m.group(1) not in SPAN_CATALOG:
+                        findings.append(Finding(
+                            CHECKER_ID, path, i,
+                            f"span name {m.group(1)!r} not declared in "
+                            f"telemetry.causal.SPAN_CATALOG"))
     return findings
 
 

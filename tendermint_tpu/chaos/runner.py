@@ -422,6 +422,13 @@ def run_chaos(spec: Optional[dict] = None, seed: int = 42,
     # a "lockwatch" section (cycles must be empty — tier-1 asserts it)
     from tendermint_tpu.analysis import lockwatch
     lockcheck = lockwatch.maybe_install()
+    # causal flight recorder: chaos runs trace by default (the span ring
+    # is the post-mortem for any violation); an explicit TM_TPU_TRACE=off
+    # in the env still wins inside causal.enabled()
+    from tendermint_tpu.telemetry import causal
+    trace_prev = causal._configured
+    causal.configure("on")
+    causal.clear()
     net = ChaosNet(workdir, spec, seed, n=n)
     try:
         net.start()
@@ -435,8 +442,20 @@ def run_chaos(spec: Optional[dict] = None, seed: int = 42,
                 tempfile.gettempdir(), f"chaos_trace_{seed}.json")
             net.monitor.dump_trace(path, net.schedule, report)
             report["trace"] = path
+        if report["violations"] and causal.enabled():
+            # archive the span ring next to the replayable trace: the
+            # violation's timeline (who proposed, when quorum formed,
+            # what stalled) outlives the torn-down net
+            import json as _json
+            rec = (report.get("trace") or os.path.join(
+                tempfile.gettempdir(),
+                f"chaos_trace_{seed}.json")) + ".timeline.json"
+            with open(rec, "w") as f:
+                _json.dump(causal.dump(), f)
+            report["flight_recorder"] = rec
         return report
     finally:
         net.stop()
+        causal.configure(trace_prev)
         if own_dir:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -54,6 +54,11 @@ class BaseConfig:
     # persistence. auto|on|off; TM_TPU_PIPELINE wins over this. "off"
     # restores the serial per-height code byte-for-byte.
     pipeline: str = "auto"
+    # causal tracing plane (telemetry/causal.py): per-height consensus
+    # spans, trace-stamped p2p envelopes, the dump_height_timeline RPC
+    # and the stall-detector flight recorder. off (the default) keeps
+    # the wire format byte-for-byte untraced. TM_TPU_TRACE wins.
+    trace: str = "off"
     # chaos plane (chaos/): deterministic fault injection. "off" (the
     # default) is a zero-overhead no-op — p2p links stay on the
     # existing code paths byte-for-byte. Any other value is a link

@@ -21,6 +21,7 @@ from typing import Dict, Optional
 
 from tendermint_tpu.consensus.rstate import Step
 from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.telemetry import causal
 from tendermint_tpu.p2p.conn import ChannelDescriptor
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.block import BlockID
@@ -216,6 +217,11 @@ class ConsensusReactor(Reactor):
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         msg = encoding.cloads(msg_bytes)
         t = msg.get("type")
+        # strip the causal trace stamp FIRST: the state machine (and its
+        # WAL) must see exactly the untraced message shape, and the
+        # receive-side link span it records is the clock-alignment
+        # sample scripts/trace_merge.py aligns node timelines with
+        causal.take(msg, t or "")
         ps: Optional[PeerRoundState] = self.peer_states.get(peer.id)
         if ps is None:
             return
@@ -388,15 +394,16 @@ class ConsensusReactor(Reactor):
             return
         t = msg.get("type")
         if t == "new_round_step":
-            self.switch.broadcast_obj(STATE_CHANNEL, {
+            self.switch.broadcast_obj(STATE_CHANNEL, causal.stamp({
                 "type": "new_round_step", "height": msg["height"],
                 "round": msg["round"], "step": msg["step"],
-                "last_commit_round": msg.get("last_commit_round", -1)})
+                "last_commit_round": msg.get("last_commit_round", -1)},
+                msg["height"], msg["round"]))
         elif t == "has_vote":
-            self.switch.broadcast_obj(STATE_CHANNEL, {
+            self.switch.broadcast_obj(STATE_CHANNEL, causal.stamp({
                 "type": "has_vote", "height": msg["height"],
                 "round": msg["round"], "vote_type": msg["vote_type"],
-                "index": msg["index"]})
+                "index": msg["index"]}, msg["height"], msg["round"]))
         elif t == "heartbeat":
             # proposal heartbeat while waiting for txs
             # (consensus/reactor.go ProposalHeartbeatMessage)
@@ -462,12 +469,16 @@ class ConsensusReactor(Reactor):
                                 "part": part.to_obj()}
                             break
             if proposal_msg is not None:
+                p = proposal_msg["proposal"]
+                causal.stamp(proposal_msg, p["height"], p["round"])
                 if peer.send(DATA_CHANNEL, encoding.cdumps(proposal_msg)):
                     ps.set_has_proposal(
                         proposal_msg["proposal"]["block_parts_header"]
                         ["total"])
                     sent = True
             elif part_msg is not None:
+                causal.stamp(part_msg, part_msg["height"],
+                             part_msg["round"])
                 if peer.send(DATA_CHANNEL, encoding.cdumps(part_msg)):
                     ps.set_has_part(part_msg["part"]["index"])
                     sent = True
@@ -528,6 +539,8 @@ class ConsensusReactor(Reactor):
                                         "vote": pc.to_obj()}
                             break
             if vote_msg is not None:
+                vv = vote_msg["vote"]
+                causal.stamp(vote_msg, vv["height"], vv["round"])
                 if peer.send(VOTE_CHANNEL, encoding.cdumps(vote_msg)):
                     v = vote_msg["vote"]
                     ps.set_has_vote(v["height"], v["round"], v["type"],

@@ -24,6 +24,7 @@ from collections import deque
 from typing import Callable, List, Optional
 
 from tendermint_tpu import pipeline, telemetry
+from tendermint_tpu.telemetry import causal
 from tendermint_tpu.config import ConsensusConfig
 from tendermint_tpu.consensus.rstate import HeightVoteSet, RoundState, Step
 from tendermint_tpu.consensus.ticker import MockTicker, TimeoutInfo, TimeoutTicker
@@ -73,7 +74,11 @@ class ConsensusState:
                  priv_validator=None, wal=None, event_bus=None,
                  ticker_factory=TimeoutTicker):
         from tendermint_tpu.utils.log import get_logger
-        self.logger = get_logger("consensus")
+        # _new_step rebinds height/round onto self.logger every step, so
+        # every consensus line is grep-able by height without each call
+        # site threading the fields through
+        self._logger_base = get_logger("consensus")
+        self.logger = self._logger_base
         self.config = config
         self.state = state             # last committed State
         self.block_exec = block_exec
@@ -101,6 +106,10 @@ class ConsensusState:
         # once at construction so a state machine never switches modes
         # mid-height. off = the serial per-height code byte-for-byte.
         self._pipeline = pipeline.resolve()
+        # causal tracing plane (telemetry/causal.py, TM_TPU_TRACE):
+        # resolved once like the pipeline knob; off = zero per-height
+        # span recording and untouched broadcast envelopes
+        self._trace = causal.enabled()
         self._pre_lock = threading.Lock()
         # next-proposal precompute handoff (worker -> propose step)
         self._precomputed = None  #: guarded_by _pre_lock
@@ -215,6 +224,19 @@ class ConsensusState:
         self.logger.error(s, height=self.rs.height, round=self.rs.round,
                           step=self.rs.step.name)
 
+    def _cpoint(self, name: str, height: int, round_: int = -1,
+                **args) -> None:
+        """One causal timeline point — never during replay (a replayed
+        step is not new cluster progress; the live run already recorded
+        it, and a catchup replay would re-stamp old heights with NOW)."""
+        if self._trace and not self.replay_mode:
+            causal.point(name, height, round_, **args)
+
+    def _cspan(self, name: str, height: int, round_: int = -1, **args):
+        if self._trace and not self.replay_mode:
+            return causal.span(name, height, round_, **args)
+        return causal.null_span()
+
     def _publish(self, event: str, extra: Optional[dict] = None) -> None:
         if self.event_bus is not None and not self.replay_mode:
             obj = self.rs.round_state_event_obj()
@@ -289,6 +311,8 @@ class ConsensusState:
 
     def _new_step(self) -> None:
         self.n_steps += 1
+        self.logger = self._logger_base.with_fields(
+            height=self.rs.height, round=self.rs.round)
         # replayed steps (WAL catchup/handshake) are not new consensus
         # progress — they must not inflate counters or the timeline
         if telemetry.enabled() and not self.replay_mode:
@@ -358,6 +382,7 @@ class ConsensusState:
         rs.round = round_
         rs.step = Step.NEW_ROUND
         self._round_t0 = time.perf_counter()
+        self._cpoint("height.begin", height, round_)
         rs.validators = validators
         if round_ != 0:
             rs.proposal = None
@@ -414,6 +439,12 @@ class ConsensusState:
         if rs.height != height or round_ < rs.round or \
                 (rs.round == round_ and rs.step >= Step.PROPOSE):
             return
+        if rs.step == Step.NEW_HEIGHT:
+            # txs_available shortcut: propose entered straight from the
+            # NewHeight wait, bypassing _enter_new_round — this IS the
+            # height's work starting (under sustained tx load it is the
+            # common path, so the timeline must anchor here too)
+            self._cpoint("height.begin", height, round_)
 
         try:
             self._schedule_timeout(self.config.propose_timeout_s(round_),
@@ -424,7 +455,8 @@ class ConsensusState:
             if not rs.validators.has_address(addr):
                 return
             if rs.validators.proposer().address == addr:
-                self._decide_proposal(height, round_)
+                with self._cspan("propose", height, round_):
+                    self._decide_proposal(height, round_)
         finally:
             rs.round = round_
             rs.step = Step.PROPOSE
@@ -684,6 +716,8 @@ class ConsensusState:
             return
 
         self._publish("Polka")
+        if not maj.is_zero():
+            self._cpoint("quorum.prevote", height, round_)
 
         if maj.is_zero():
             # +2/3 prevoted nil: unlock and precommit nil
@@ -756,6 +790,7 @@ class ConsensusState:
         maj = pc.two_thirds_majority() if pc is not None else None
         if maj is None:
             raise ConsensusFailure("enterCommit expects +2/3 precommits")
+        self._cpoint("quorum.precommit", height, commit_round)
 
         if rs.locked_block is not None and rs.locked_block.hash() == maj.hash:
             rs.proposal_block = rs.locked_block
@@ -812,12 +847,14 @@ class ConsensusState:
         fail.fail_point("consensus.before_save_block")
         if self.block_store.height() < block.header.height:
             seen_commit = pc.make_commit()
-            self.block_store.save_block(block, parts, seen_commit)
+            with self._cspan("flush", height):
+                self.block_store.save_block(block, parts, seen_commit)
 
         fail.fail_point("consensus.before_wal_end_height")
         # ENDHEIGHT marks the WAL before ApplyBlock: if we crash between
         # the two, handshake replay redoes ApplyBlock (consensus/replay.go)
-        self.wal.save_end_height(height)
+        with self._cspan("wal.fsync", height):
+            self.wal.save_end_height(height)
         fail.fail_point("consensus.after_wal_end_height")
 
         block_id = BlockID(block.hash(), parts.header())
@@ -834,6 +871,8 @@ class ConsensusState:
             telemetry.instant("cs:finalize_commit", height=height,
                               round=rs.commit_round,
                               txs=len(block.data.txs))
+        self._cpoint("commit", height, rs.commit_round,
+                     txs=len(block.data.txs))
 
         self._update_to_state(new_state)
         self._schedule_round0()
@@ -882,10 +921,12 @@ class ConsensusState:
                 pre_validated=True)
         fail.fail_point("consensus.before_group_flush")
         with pipeline.stage_timer("persist") as t_persist:
-            group.flush()
+            with self._cspan("flush", height):
+                group.flush()
             fail.fail_point("consensus.after_group_flush")
             fail.fail_point("consensus.before_wal_end_height")
-            self.wal.save_end_height(height)  # the height's one fsync
+            with self._cspan("wal.fsync", height):
+                self.wal.save_end_height(height)  # the height's one fsync
         fail.fail_point("consensus.after_wal_end_height")
         fail.fail_point("consensus.after_apply_block")
         self._serial_s += t_apply.seconds + t_persist.seconds
@@ -901,6 +942,8 @@ class ConsensusState:
                               txs=len(block.data.txs))
             pipeline.observe_overlap(self._overlap_s,
                                      self._overlap_s + self._serial_s)
+        self._cpoint("commit", height, rs.commit_round,
+                     txs=len(block.data.txs))
 
         self._update_to_state(new_state)
         self._kick_precompute()
@@ -930,6 +973,7 @@ class ConsensusState:
                 proposer.pubkey, proposal.sign_bytes(self.state.chain_id),
                 proposal.signature):
             raise ValueError("invalid proposal signature")
+        self._cpoint("proposal.recv", proposal.height, proposal.round)
         rs.proposal = proposal
         if rs.proposal_block_parts is None or \
                 not rs.proposal_block_parts.has_header(
@@ -944,6 +988,12 @@ class ConsensusState:
         if rs.proposal_block_parts is None:
             return
         added = rs.proposal_block_parts.add_part(part)
+        if added and self._trace:
+            if rs.proposal_block_parts.count == 1:
+                self._cpoint("part.first", height, rs.round)
+            if rs.proposal_block_parts.is_complete():
+                self._cpoint("block.full", height, rs.round,
+                             parts=rs.proposal_block_parts.total)
         if added and rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.get_data()
             block = Block.from_bytes(data)

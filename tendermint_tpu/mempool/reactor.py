@@ -13,6 +13,7 @@ from typing import Dict
 
 from tendermint_tpu.mempool.mempool import Mempool, MempoolFull, TxAlreadyInCache
 from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.telemetry import causal
 from tendermint_tpu.p2p.conn import ChannelDescriptor
 from tendermint_tpu.types import encoding
 
@@ -50,6 +51,7 @@ class MempoolReactor(Reactor):
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         msg = encoding.cloads(msg_bytes)
         t = msg.get("type")
+        causal.take(msg, t or "")  # trace stamp off before validation
         if t == "tx":
             txs = [msg["tx"]]
         elif t == "txs":
@@ -138,6 +140,10 @@ class MempoolReactor(Reactor):
             if batch:
                 msg = ({"type": "tx", "tx": batch[0]} if len(batch) == 1
                        else {"type": "txs", "txs": batch})
+                # trace context: the admission height of the batch head
+                # places tx gossip on the cluster timeline (and its
+                # send/recv pair is one more clock-alignment sample)
+                causal.stamp(msg, el.value.height)
                 if not peer.send(MEMPOOL_CHANNEL, encoding.cdumps(msg)):
                     time.sleep(PEER_CATCHUP_SLEEP_S)
                     continue

@@ -279,6 +279,11 @@ class BatchVerifier:
             out0 = np.zeros(0, np.bool_)
             return lambda: out0
         t_dispatch = time.perf_counter()
+        # causal timeline marker (no height at this layer — the cluster
+        # merge shows WHEN verify work ran relative to consensus stages)
+        from tendermint_tpu.telemetry import causal
+        if causal.enabled():
+            causal.point("verify.dispatch", -1, n=n, backend=self.backend)
         _m_batch_size.observe(n)
         use_jax = self.backend == "jax" or (
             self.backend == "auto" and n > self.auto_threshold)

@@ -71,6 +71,16 @@ class Node:
         from tendermint_tpu import pipeline as _pipeline
         _pipeline.configure(mode=getattr(config.base, "pipeline", "auto"))
 
+        # causal tracing plane (env TM_TPU_TRACE wins inside enabled();
+        # off = untraced wire bytes + zero span recording). The node id
+        # is refined to the p2p identity in _build_p2p.
+        from tendermint_tpu.telemetry import causal as _causal
+        _causal.configure(mode=getattr(config.base, "trace", "off"))
+        if not _causal.node():
+            _causal.set_node(getattr(config.base, "moniker", "") or
+                             f"pid{os.getpid()}")
+        self._stall_detector = None
+
         def db_path(name):
             if in_memory:
                 return None
@@ -207,6 +217,19 @@ class Node:
             network=self.gen_doc.chain_id)
         self.switch = Switch(self.config.p2p, node_key, node_info)
 
+        # the p2p identity IS the node label everywhere observability
+        # correlates: the causal trace plane (wire stamps + dumps), the
+        # keepalive-RTT provider the merger cross-checks against, and
+        # the process-global log context (grep-by-node across a
+        # testnet's interleaved logs)
+        from tendermint_tpu.telemetry import causal as _causal
+        from tendermint_tpu.utils import log as _log
+        _causal.set_node(node_info.id[:12])
+        _causal.set_rtt_provider(
+            lambda: {p.id[:12]: p.rtt_s
+                     for p in self.switch.peers.list()})
+        _log.bind(node=node_info.id[:8])
+
         self.consensus_reactor = ConsensusReactor(
             self.consensus, fast_sync=fast_sync,
             gossip_sleep_s=self.config.consensus.peer_gossip_sleep_ms / 1e3)
@@ -271,6 +294,18 @@ class Node:
 
         self.indexer_service.start()
 
+        # stall-detector flight recorder (TM_TPU_TRACE on + a nonzero
+        # TM_TPU_TRACE_STALL_S window): no height progress for the
+        # window dumps the causal timeline + consensus state for
+        # post-mortem, once per stall episode
+        from tendermint_tpu.telemetry import causal as _causal
+        from tendermint_tpu.utils import knobs as _knobs
+        stall_s = _knobs.knob_float("TM_TPU_TRACE_STALL_S", default=0.0)
+        if _causal.enabled() and stall_s > 0:
+            self._stall_detector = _causal.StallDetector(
+                lambda: self.height, self._on_stall, stall_s)
+            self._stall_detector.start()
+
         # HTTP and gRPC listeners are independent: asking for one must
         # not bind the other (a gRPC-only operator should not get the
         # full JSON-RPC surface on the config-default 0.0.0.0 address)
@@ -301,7 +336,42 @@ class Node:
             self.switch.dial_peers_async(
                 [NetAddress.from_string(a) for a in seeds])
 
+    def _on_stall(self, height: int, stalled_s: float) -> None:
+        """Flight-recorder dump: the causal timeline plus the same
+        consensus snapshot the dump_consensus_state RPC serves, written
+        where a post-mortem will look (the node's data dir when it has
+        one, else the system tempdir)."""
+        import json
+        import tempfile
+        import time as _time
+        from tendermint_tpu.rpc import RPCCore, RPCEnv
+        from tendermint_tpu.telemetry import causal as _causal
+        doc = {"height": height, "stalled_s": round(stalled_s, 3),
+               "timeline": _causal.dump()}
+        try:
+            core = RPCCore(RPCEnv.from_node(self))
+            doc["consensus"] = core.dump_consensus_state()
+        except Exception as e:
+            doc["consensus_error"] = repr(e)
+        out_dir = tempfile.gettempdir()
+        if self.config.home:
+            d = self.config.path(self.config.base.db_dir)
+            if os.path.isdir(d):
+                out_dir = d
+        path = os.path.join(
+            out_dir, f"tm_stall_h{height}_{int(_time.time())}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            self.logger.error("consensus stalled: flight recorder dumped",
+                              height=height,
+                              stalled_s=round(stalled_s, 1), path=path)
+        except OSError as e:
+            self.logger.error("stall dump failed", err=repr(e))
+
     def stop(self) -> None:
+        if getattr(self, "_stall_detector", None) is not None:
+            self._stall_detector.stop()
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop()
         if self.rpc_server is not None:

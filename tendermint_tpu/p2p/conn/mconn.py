@@ -29,6 +29,10 @@ _m_frames_per_burst = telemetry.histogram(
     "p2p_frames_per_burst",
     "Frames per coalesced link burst, by direction",
     ("direction",), buckets=telemetry.POW2_BUCKETS)
+_m_keepalive_rtt = telemetry.histogram(
+    "p2p_keepalive_rtt_seconds",
+    "Ping->pong round trip per connection (the trace merger's "
+    "clock-alignment cross-check)")
 
 PACKET_PING = 0x01
 PACKET_PONG = 0x02
@@ -105,6 +109,8 @@ class MConnection:
         self._stopped = False                 #: guarded_by _cond
         self._errored = False                 #: guarded_by _cond
         self._last_recv = time.monotonic()    #: guarded_by _cond
+        self._ping_sent = 0.0                 #: guarded_by _cond
+        self._last_rtt = 0.0                  #: guarded_by _cond
         self._threads: List[threading.Thread] = []
         # burst frame plane (ISSUE 3): coalesce up to _burst_max packets
         # per link write (one AEAD burst + one sendall on a
@@ -150,6 +156,12 @@ class MConnection:
     def running(self) -> bool:
         with self._cond:
             return not self._stopped
+
+    def rtt_s(self) -> float:
+        """Last keepalive ping->pong round trip (0.0 before the first
+        completes)."""
+        with self._cond:
+            return self._last_rtt
 
     def _error(self, e: Exception) -> None:
         with self._cond:
@@ -270,6 +282,8 @@ class MConnection:
                     self.link.write(bytes([PACKET_PING]))
                     self.send_monitor.update(1)
                     last_ping = now
+                    with self._cond:
+                        self._ping_sent = time.monotonic()
                 if self._burst_write and len(packets) > 1:
                     # one AEAD burst + one sendall for the whole drain;
                     # flowrate updates once per burst (payload bytes,
@@ -330,7 +344,18 @@ class MConnection:
                 self._pong_due += 1
                 self._cond.notify_all()
         elif ptype == PACKET_PONG:
-            pass
+            # keepalive RTT sample: at most one ping is in flight
+            # (interval >> RTT), so pairing pong to the last ping is
+            # exact. The sample feeds the trace merger's clock-offset
+            # sanity check and the tm_p2p_keepalive_rtt histogram.
+            rtt = 0.0
+            with self._cond:
+                if self._ping_sent:
+                    rtt = time.monotonic() - self._ping_sent
+                    self._ping_sent = 0.0
+                    self._last_rtt = rtt
+            if rtt and telemetry.enabled():
+                _m_keepalive_rtt.observe(rtt)
         elif ptype == PACKET_MSG:
             ch_id, eof = frame[1], frame[2]
             ch = self.channels.get(ch_id)

@@ -71,7 +71,9 @@ class Switch:
     def __init__(self, config, node_key: NodeKey, node_info: NodeInfo,
                  encrypt: bool = True):
         from tendermint_tpu.utils.log import get_logger
-        self.logger = get_logger("p2p")
+        # bound node id: several switches share a test process, and a
+        # p2p line is useless without knowing WHOSE switch logged it
+        self.logger = get_logger("p2p", node=node_info.id[:8])
         self.config = config
         self.node_key = node_key
         self.node_info = node_info

@@ -99,6 +99,7 @@ class RPCCore:
             "tx": self.tx,
             "tx_search": self.tx_search,
             "metrics": self.metrics,
+            "dump_height_timeline": self.dump_height_timeline,
         }
         if self.env.unsafe:
             r.update({
@@ -154,6 +155,7 @@ class RPCCore:
             "peers": [{
                 "node_info": p.node_info.to_obj(),
                 "is_outbound": p.outbound,
+                "rtt_s": round(p.rtt_s, 6),
             } for p in sw.peers.list()],
         })
 
@@ -430,6 +432,20 @@ class RPCCore:
                 "namespace": telemetry.namespace(),
                 "exposition": telemetry.expose()}
 
+    def dump_height_timeline(self, min_height: int = 0,
+                             max_height: int = 0) -> dict:
+        """The node's causal span ring (telemetry/causal.py) + merge
+        metadata: wall-clock anchor, keepalive RTT per peer, drop
+        accounting. scripts/trace_merge.py fetches this route from
+        every node and aligns the buffers into one cluster timeline.
+        Empty (enabled=false) unless TM_TPU_TRACE is on."""
+        from tendermint_tpu.telemetry import causal
+        d = causal.dump(min_height, max_height)
+        cs = self.env.consensus
+        if cs is not None:
+            d["height"] = cs.state.last_block_height
+        return jsonify(d)
+
     def unsafe_dump_trace(self, filename: str = "") -> dict:
         """Write the in-memory consensus/verifier timeline as
         Chrome-trace JSON (chrome://tracing, ui.perfetto.dev)."""
@@ -568,4 +584,7 @@ def make_server(env: RPCEnv):
     # raw Prometheus scrape path; serves the (possibly empty) registry
     # even when telemetry is disabled so scrapers never see a 404 flap
     server.metrics_provider = telemetry.expose
+    # raw GET /debug/timeline: the causal span ring as JSON (curl-able
+    # without a JSON-RPC envelope; same payload as dump_height_timeline)
+    server.timeline_provider = core.dump_height_timeline
     return server, core

@@ -293,6 +293,9 @@ class RPCServer:
         # Prometheus exposition (text/plain) instead of JSON-RPC routing
         # — scrapers speak raw HTTP, not JSON-RPC envelopes
         self.metrics_provider: Optional[Callable[[], str]] = None
+        # when set, GET /debug/timeline serves this callable's dict as
+        # JSON — the causal span ring for trace_merge/curl consumers
+        self.timeline_provider: Optional[Callable[[], dict]] = None
 
     def register(self, name: str, fn: Callable, ws_only: bool = False) -> None:
         self.funcs[name] = RPCFunc(fn, ws_only=ws_only)
@@ -383,6 +386,15 @@ class RPCServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    return
+                if url.path == "/debug/timeline" and \
+                        server.timeline_provider is not None:
+                    try:
+                        self._reply(server.timeline_provider())
+                    except Exception as e:
+                        self._reply(_rpc_response(None, error=RPCError(
+                            -32603, f"timeline provider failed: {e}")),
+                            500)
                     return
                 method = url.path.strip("/")
                 if method == "":

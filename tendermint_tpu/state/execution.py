@@ -168,46 +168,51 @@ class BlockExecutor:
         pipelined finalize, which validates once for the consensus
         failure classification and must not pay the commit-signature
         batch twice per height."""
+        from tendermint_tpu.telemetry import causal
         from tendermint_tpu.utils import fail
-        if not pre_validated:
-            self.validate_block(state, block,
-                                trust_last_commit=trust_last_commit)
-        responses = exec_block_on_app(self.app_conn, block, state.validators)
-        fail.fail_point("execution.after_exec_block")
-        state_store = self.state_store
-        if group is not None and state_store is not None:
-            from tendermint_tpu.storage.state_store import StateStore
-            state_store = StateStore(group.staged(self.state_store.db))
-        if state_store is not None:
-            state_store.save_abci_responses(
-                block.header.height, responses.to_obj())
-        fail.fail_point("execution.after_save_abci_responses")
-        new_state = update_state(state, block_id, block, responses)
+        with causal.span("apply", block.header.height,
+                         txs=len(block.data.txs)):
+            if not pre_validated:
+                self.validate_block(state, block,
+                                    trust_last_commit=trust_last_commit)
+            responses = exec_block_on_app(self.app_conn, block,
+                                          state.validators)
+            fail.fail_point("execution.after_exec_block")
+            state_store = self.state_store
+            if group is not None and state_store is not None:
+                from tendermint_tpu.storage.state_store import StateStore
+                state_store = StateStore(group.staged(self.state_store.db))
+            if state_store is not None:
+                state_store.save_abci_responses(
+                    block.header.height, responses.to_obj())
+            fail.fail_point("execution.after_save_abci_responses")
+            new_state = update_state(state, block_id, block, responses)
 
-        # Commit app + update mempool under the mempool lock
-        # (state/execution.go:125-156): no CheckTx may interleave between
-        # app Commit and mempool.update.
-        self.mempool.lock()
-        try:
-            app_hash = self.app_conn.commit()
-            self.mempool.update(block.header.height, block.data.txs)
-        finally:
-            self.mempool.unlock()
+            # Commit app + update mempool under the mempool lock
+            # (state/execution.go:125-156): no CheckTx may interleave
+            # between app Commit and mempool.update.
+            self.mempool.lock()
+            try:
+                app_hash = self.app_conn.commit()
+                self.mempool.update(block.header.height, block.data.txs)
+            finally:
+                self.mempool.unlock()
 
-        fail.fail_point("execution.after_app_commit")
-        new_state.app_hash = app_hash
-        if state_store is not None:
-            state_store.save(new_state)
-        fail.fail_point("execution.after_save_state")
-        self.evidence_pool.update(block, new_state)
-        if self.event_bus is not None:
-            if group is None:
-                fire_events(self.event_bus, block, block_id, responses)
-            else:
-                bus = self.event_bus
-                group.after_flush(
-                    lambda: fire_events(bus, block, block_id, responses))
-        return new_state
+            fail.fail_point("execution.after_app_commit")
+            new_state.app_hash = app_hash
+            if state_store is not None:
+                state_store.save(new_state)
+            fail.fail_point("execution.after_save_state")
+            self.evidence_pool.update(block, new_state)
+            if self.event_bus is not None:
+                if group is None:
+                    fire_events(self.event_bus, block, block_id, responses)
+                else:
+                    bus = self.event_bus
+                    group.after_flush(
+                        lambda: fire_events(bus, block, block_id,
+                                            responses))
+            return new_state
 
     def exec_commit_block(self, block: Block) -> bytes:
         """Execute + commit WITHOUT state updates — fast-sync / handshake

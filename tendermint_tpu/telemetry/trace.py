@@ -28,6 +28,20 @@ from tendermint_tpu.telemetry.registry import _state
 # few thousand heights of timeline before the oldest roll off.
 DEFAULT_CAPACITY = 65536
 
+# Ring overflow accounting, shared with the causal span ring
+# (telemetry/causal.py): long soaks stay bounded BY DESIGN, and the
+# counter is how a dump consumer learns its window was truncated.
+from tendermint_tpu.telemetry.registry import REGISTRY as _REGISTRY
+
+_m_dropped = _REGISTRY.counter(
+    "trace_events_dropped_total",
+    "Trace ring events displaced by the capacity cap "
+    "(Chrome tracer + causal span ring)", ())
+
+
+def note_dropped(n: int = 1) -> None:
+    _m_dropped.inc(n)
+
 
 class _NullSpan:
     def __enter__(self):
@@ -42,9 +56,21 @@ _NULL_SPAN = _NullSpan()
 
 class Tracer:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self._events: deque = deque(maxlen=capacity)
+        # explicit cap + drop accounting, NOT deque(maxlen): maxlen
+        # evicts silently, and a week-long soak whose ring wrapped looks
+        # exactly like a quiet node unless the drops are counted
+        self._events: deque = deque()        #: guarded_by _lock
+        self._capacity = max(1, int(capacity))
+        self.dropped = 0                     #: guarded_by _lock
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+
+    def _append_locked(self, ev: dict) -> None:
+        if len(self._events) >= self._capacity:
+            self._events.popleft()
+            self.dropped += 1
+            note_dropped()
+        self._events.append(ev)
 
     # ------------------------------------------------------------ record
 
@@ -61,7 +87,7 @@ class Tracer:
         if args:
             ev["args"] = args
         with self._lock:
-            self._events.append(ev)
+            self._append_locked(ev)
 
     def complete(self, name: str, start_s: float, end_s: float,
                  **args) -> None:
@@ -77,7 +103,7 @@ class Tracer:
         if args:
             ev["args"] = args
         with self._lock:
-            self._events.append(ev)
+            self._append_locked(ev)
 
     @contextmanager
     def _span_cm(self, name: str, args: dict):

@@ -105,6 +105,20 @@ CATALOG: tuple[Knob, ...] = (
          "base.telemetry",
          "off disables all metrics/tracing; any other value forces on.",
          "telemetry/registry.py"),
+    Knob("TM_TPU_TRACE", "str", "off", "base.trace",
+         "Causal tracing plane: on stamps p2p envelopes with trace "
+         "context and records per-height consensus spans; off keeps "
+         "the wire format byte-for-byte untraced.",
+         "telemetry/causal.py"),
+    Knob("TM_TPU_TRACE_CAP", "int", "65536", "",
+         "Causal span ring capacity; overflow drops oldest and counts "
+         "tm_trace_events_dropped_total.",
+         "telemetry/causal.py"),
+    Knob("TM_TPU_TRACE_STALL_S", "float", "0 (off)", "",
+         "Stall-detector window: with tracing on, no height progress "
+         "for this many seconds dumps timeline + consensus state "
+         "(flight recorder).",
+         "node.py"),
     # -- chaos plane -------------------------------------------------------
     Knob("TM_TPU_CHAOS", "spec", "off", "base.chaos",
          "Link fault spec, e.g. drop=0.05,delay=0.1,delay_ms=30,seed=7.",

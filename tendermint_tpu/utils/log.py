@@ -30,6 +30,35 @@ _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
 _setup_lock = threading.Lock()
 _configured = False
 
+# Process-global bound context, merged under every logger's own fields:
+# node.py binds the p2p node id at assembly, so EVERY tm.* line in a
+# testnet process carries node=<id> — interleaved multi-node logs become
+# grep-able by node, and consensus call sites layer height/round on top
+# (grep 'height=17' finds one height's full story). One node per
+# process is the deployment shape; in-process multi-node tests see the
+# last binder, which is why the value is informational, never load-
+# bearing.
+_ctx_lock = threading.Lock()
+_context: Dict[str, Any] = {}
+
+
+def bind(**kv) -> None:
+    """Bind process-global context fields onto every tm.* log line
+    (lowest precedence: logger fields and per-call kv override)."""
+    with _ctx_lock:
+        _context.update(kv)
+
+
+def unbind(*keys: str) -> None:
+    with _ctx_lock:
+        for k in keys:
+            _context.pop(k, None)
+
+
+def bound() -> Dict[str, Any]:
+    with _ctx_lock:
+        return dict(_context)
+
 
 class KVFormatter(logging.Formatter):
     """go-kit terminal style: level char, timestamp, message, k=v pairs."""
@@ -73,7 +102,8 @@ class TMLogger:
     def _log(self, level: int, msg: str, kv: Dict[str, Any]) -> None:
         if not self._logger.isEnabledFor(level):
             return
-        merged = dict(self.fields)
+        merged = bound()          # global context first (lowest wins)
+        merged.update(self.fields)
         merged.update(kv)
         self._logger.log(level, msg, extra={"kv": merged})
 

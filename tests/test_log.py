@@ -73,3 +73,56 @@ def test_consensus_state_log_hooked():
     cs._log("something went wrong")
     out = buf.getvalue()
     assert "something went wrong" in out and "height=7" in out
+
+
+def test_global_bound_context_in_every_line():
+    """ISSUE 8 satellite: process-global bind() (node.py binds node=<id>)
+    rides along on every tm.* line, lowest precedence."""
+    saved = tmlog.bound()
+    tmlog.unbind(*saved)  # a Node built by an earlier test binds node=
+    buf = capture()
+    tmlog.bind(node="deadbeef", height=3)
+    try:
+        tmlog.get_logger("consensus").info("entering new round")
+        tmlog.get_logger("p2p").info("peer up")
+        out = buf.getvalue()
+        assert out.count("node=deadbeef") == 2
+        assert out.count("height=3") == 2
+        # explicit kv and logger fields override the global context
+        buf2 = capture()
+        tmlog.get_logger("consensus").info("override", height=9)
+        assert "height=9" in buf2.getvalue()
+        assert "height=3" not in buf2.getvalue()
+    finally:
+        tmlog.unbind("node", "height")
+    buf3 = capture()
+    tmlog.get_logger("consensus").info("after unbind")
+    assert "node=" not in buf3.getvalue()
+    assert tmlog.bound() == {}
+    tmlog.bind(**saved)
+
+
+def test_consensus_logger_rebinds_height_round_per_step():
+    """grep-by-height: every consensus line after a step change carries
+    that step's height/round without the call site passing them."""
+    from tendermint_tpu.consensus.rstate import RoundState
+    from tendermint_tpu.consensus.state import ConsensusState
+    buf = capture()
+    cs = ConsensusState.__new__(ConsensusState)
+    cs._logger_base = tmlog.get_logger("consensus")
+    cs.logger = cs._logger_base
+    cs.rs = RoundState(height=17)
+    cs.rs.round = 2
+    cs.n_steps = 0
+    cs.replay_mode = True          # skip WAL/publish/broadcast wiring
+    from tendermint_tpu.storage.wal import NilWAL
+    cs.wal = NilWAL()
+    cs.event_bus = None
+    cs.broadcast_hooks = []
+    cs._step_open = None
+    cs._publish = lambda *a, **k: None
+    cs._broadcast = lambda *a, **k: None
+    cs._new_step()
+    cs.logger.info("plain call site")
+    out = buf.getvalue()
+    assert "height=17" in out and "round=2" in out
