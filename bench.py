@@ -372,6 +372,22 @@ def bench_coalesce_json(path: str = "BENCH_coalesce.json",
     return doc
 
 
+def bench_sync_json(path: str = "BENCH_sync.json") -> dict:
+    """Recovery-plane trajectory point (ISSUE 9): fresh-node catch-up
+    to a 300+-height chain, snapshot state-sync (statesync/reactor.py
+    restore + tail fast-sync) vs full block-replay fast-sync, over real
+    in-process p2p switches. Scale knobs: TM_BENCH_SYNC_BLOCKS /
+    _VALS / _TXS."""
+    import bench_sync
+    n = int(os.environ.get("TM_BENCH_SYNC_BLOCKS", "1920"))
+    v = int(os.environ.get("TM_BENCH_SYNC_VALS", "4"))
+    t = int(os.environ.get("TM_BENCH_SYNC_TXS", "100"))
+    doc = bench_sync.run(n, v, t, snapshot_at=max(2, n - 20))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def bench_chaos_json(path: str = "BENCH_chaos.json",
                      seed: int = 42) -> dict:
     """Chaos trajectory point (ISSUE 4): the full ACCEPTANCE_SPEC
@@ -1197,6 +1213,11 @@ if __name__ == "__main__":
         # standalone quick mode: only the BENCH_chaos.json satellite
         # (seeded fault-injection run + invariant monitor report)
         print(json.dumps(bench_chaos_json()), flush=True)
+        sys.exit(0)
+    if "--sync-json" in sys.argv:
+        # standalone quick mode: only the BENCH_sync.json satellite
+        # (fresh-node catch-up: snapshot state-sync vs block replay)
+        print(json.dumps(bench_sync_json()), flush=True)
         sys.exit(0)
     if "--p2p-json" in sys.argv:
         # standalone quick mode: only the BENCH_p2p.json satellite

@@ -57,3 +57,24 @@ class BaseApplication:
     def commit(self) -> bytes:
         """Returns the app hash for the height just executed."""
         return b""
+
+    # -- state-sync snapshot surface ------------------------------------------
+    # The analogue of ABCI ListSnapshots/OfferSnapshot/ApplySnapshotChunk
+    # for in-process apps: the snapshot writer captures the app's full
+    # key/value state at a committed height, and a restoring node
+    # installs it wholesale instead of replaying every block.
+
+    def snapshot_items(self):
+        """Iterable of (key, value) byte pairs capturing the complete
+        app state at the current height, or None when the app does not
+        support snapshots (snapshotting is then disabled for the node)."""
+        return None
+
+    def restore_items(self, items, height: int, validators=None) -> bytes:
+        """Install `items` as the COMPLETE app state at `height`
+        (replacing whatever the app held) and adopt `validators`
+        ((pubkey, power) pairs) as the active set. Returns the
+        resulting app hash — the caller aborts the restore when it
+        disagrees with the snapshot's claimed state."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support snapshot restore")

@@ -256,6 +256,45 @@ class KVStoreApp(BaseApplication):
         updates, self._val_updates = self._val_updates, []
         return ResultEndBlock(validator_updates=updates)
 
+    # -- state-sync snapshot surface ------------------------------------------
+
+    def snapshot_items(self):
+        """The complete kv state, sorted by key — deterministic across
+        the native and pure-Python cores, so two nodes at the same
+        height publish byte-identical snapshot payloads."""
+        return sorted(self.store.items())
+
+    def restore_items(self, items, height: int, validators=None) -> bytes:
+        """Install a snapshot's kv state wholesale: reset every core
+        structure, replay the pairs through the normal set path, and
+        compute the app hash via the ordinary commit() machinery (the
+        height bookkeeping lands on exactly `height`). The resulting
+        hash MUST match the snapshot state's app_hash — the caller
+        verifies and aborts on mismatch."""
+        if self._core is not None:
+            # a fresh native core is cheaper and simpler than clearing
+            self._core = self._kvmod.kv_new()
+            self.store = _NativeStoreView(self._kvmod, self._core)
+            for k, v in items:
+                self._kvmod.set_one(self._core, bytes(k), bytes(v))
+        else:
+            self.store = {}
+            self._bucket_acc = [0] * N_BUCKETS
+            self._bucket_count = [0] * N_BUCKETS
+            self._bucket_digest = bytearray(_EMPTY_BUCKET * N_BUCKETS)
+            self._pair_digest = {}
+            self._dirty = set()
+            for k, v in items:
+                self.store[bytes(k)] = bytes(v)
+                self._dirty.add(bytes(k))
+        if validators is not None:
+            self._validators = {bytes(pk): int(power)
+                                for pk, power in validators}
+            self._val_seeded = True
+        self._val_updates = []
+        self.height = height - 1
+        return self.commit()  # height -> `height`, app_hash recomputed
+
     def query(self, path: str, data: bytes, height: int,
               prove: bool) -> ResultQuery:
         value = self.store.get(data, b"")

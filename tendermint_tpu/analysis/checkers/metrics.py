@@ -30,6 +30,7 @@ KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
+    "snapshot", "sync", "prune",
 }
 
 INSTRUMENTED_MODULES = [
@@ -49,6 +50,8 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.pipeline",           # tm_pipeline_* hot-path stages
     "tendermint_tpu.types.part_set",     # tm_partset_build_seconds
     "tendermint_tpu.telemetry.trace",    # tm_trace_events_dropped_total
+    "tendermint_tpu.storage.snapshot",   # tm_snapshot_* / tm_prune_*
+    "tendermint_tpu.statesync.reactor",  # tm_sync_* chunk/restore plane
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

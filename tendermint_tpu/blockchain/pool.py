@@ -3,19 +3,29 @@
 
 Tracks peers and their advertised heights, keeps up to
 MAX_PENDING_REQUESTS heights in flight (each assigned to one peer),
-collects responses, and hands completed consecutive blocks to the reactor
-via `peek_two_blocks`. Slow peers (lifetime recv rate under
-MIN_RECV_RATE) and timed-out requests get their peer dropped and the
-heights reassigned (:35-42, 122-143)."""
+collects responses, and hands completed consecutive blocks to the
+reactor via `peek_two_blocks`/`peek_window`.
+
+Peer discipline (PR 9 hardening — the reference's fixed stale-request
+sweep evicted a peer on its FIRST slow window, which under load
+dead-ended the rejoin path): a timed-out or slow request now STRIKES
+its peer and puts it on per-peer exponential backoff with
+deterministic jitter (clocked via utils/clock.now_s, so chaos
+skew/replay reproduce the exact schedule); requests route away from
+struck peers toward responsive ones, and only MAX_STRIKES consecutive
+failures evict — never the last remaining peer, which is throttled
+instead (a slow sync beats a dead one)."""
 
 from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu import telemetry
 from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+from tendermint_tpu.utils import clock
 
 # Fast-sync window health: how many completed blocks sit buffered ahead
 # of the apply height (the paper's blocks/sec number starves when this
@@ -29,16 +39,28 @@ _m_requests = telemetry.counter(
     "fastsync_requests_total", "Block requests sent to peers")
 _m_height = telemetry.gauge(
     "fastsync_height", "Next height the fast-sync pool will apply")
+_m_strikes = telemetry.counter(
+    "fastsync_peer_strikes_total",
+    "Request timeouts / slow windows charged to peers")
 
 MAX_PENDING_REQUESTS = 1000       # blockchain/pool.go:31
 MAX_PENDING_PER_PEER = 50
 MIN_RECV_RATE = 7680              # B/s (blockchain/pool.go:35-42)
-PEER_TIMEOUT_S = 15.0
+REQUEST_TIMEOUT_S = 15.0
 MIN_RATE_GRACE_S = 2.0
+MAX_STRIKES = 3                   # consecutive failures before eviction
+BACKOFF_BASE_S = 1.0
+BACKOFF_CAP_S = 30.0
+
+
+def _jitter(peer_id: str, n: int) -> float:
+    """Deterministic per-(peer, strike) jitter in [0, 1): derived from
+    a hash, not a RNG, so a chaos replay reproduces the schedule."""
+    return (zlib.crc32(f"{peer_id}:{n}".encode()) % 1000) / 1000.0
 
 
 class BpPeer:
-    """blockchain/pool.go:369 bpPeer."""
+    """blockchain/pool.go:369 bpPeer + strike/backoff discipline."""
 
     def __init__(self, peer_id: str, height: int):
         self.id = peer_id
@@ -46,6 +68,9 @@ class BpPeer:
         self.num_pending = 0
         self.recv_monitor = FlowMonitor()
         self.burst_started_at = 0.0
+        self.strikes = 0          # consecutive timeouts / slow windows
+        self.backoff_until = 0.0  # clock.now_s() deadline
+        self.blocks_received = 0
 
     def on_request(self) -> None:
         if self.num_pending == 0:
@@ -63,6 +88,21 @@ class BpPeer:
     def on_block(self, size: int) -> None:
         self.num_pending = max(0, self.num_pending - 1)
         self.recv_monitor.update(size)
+        self.blocks_received += 1
+        self.strikes = 0
+        self.backoff_until = 0.0
+
+    def strike(self, now: float) -> None:
+        """One failure: exponential backoff with deterministic jitter."""
+        self.strikes += 1
+        base = min(BACKOFF_CAP_S,
+                   BACKOFF_BASE_S * (2 ** (self.strikes - 1)))
+        self.backoff_until = now + base * (1.0 + 0.5 * _jitter(
+            self.id, self.strikes))
+        _m_strikes.inc()
+
+    def in_backoff(self, now: float) -> bool:
+        return now < self.backoff_until
 
     def is_slow(self) -> bool:
         if self.num_pending == 0:
@@ -79,7 +119,7 @@ class _Request:
         self.height = height
         self.peer_id = peer_id
         self.block = None
-        self.sent_at = time.monotonic()
+        self.sent_at = clock.now_s()
 
 
 class BlockPool:
@@ -131,19 +171,36 @@ class BlockPool:
 
     # -------------------------------------------------------------- requests
 
+    def reset_height(self, start_height: int) -> None:
+        """Adopt a new sync frontier (a state-sync restore landed):
+        drop every request below it and resume from there."""
+        with self._lock:
+            self.height = max(self.height, start_height)
+            for h in list(self.requests):
+                if h < self.height:
+                    req = self.requests.pop(h)
+                    if req.block is not None:
+                        self._n_filled = max(0, self._n_filled - 1)
+                    p = self.peers.get(req.peer_id)
+                    if p is not None and req.block is None:
+                        p.on_request_failed()
+            _m_height.set(self.height)
+            _m_window_fill.set(self._n_filled)
+
     def make_next_requests(self) -> None:
         """Assign un-requested heights to capable peers (the reference's
         makeRequestersRoutine + pickIncrAvailablePeer)."""
         to_send: List[tuple] = []
         with self._lock:
+            now = clock.now_s()
             max_h = max((p.height for p in self.peers.values()), default=0)
             # reassign orphaned requests (their peer vanished/timed out)
             for req in self.requests.values():
                 if req.block is None and req.peer_id == "":
-                    peer = self._pick_peer(req.height)
+                    peer = self._pick_peer(req.height, now)
                     if peer is not None:
                         req.peer_id = peer.id
-                        req.sent_at = time.monotonic()
+                        req.sent_at = now
                         peer.on_request()
                         to_send.append((peer.id, req.height))
             next_h = self.height
@@ -152,7 +209,7 @@ class BlockPool:
                     next_h += 1
                 if next_h > max_h:
                     break
-                peer = self._pick_peer(next_h)
+                peer = self._pick_peer(next_h, now)
                 if peer is None:
                     break
                 req = _Request(next_h, peer.id)
@@ -171,31 +228,45 @@ class BlockPool:
                     if p is not None:
                         p.on_request_failed()  # drain the phantom pending
 
-    def _pick_peer(self, height: int) -> Optional[BpPeer]:
+    def _pick_peer(self, height: int, now: float) -> Optional[BpPeer]:
+        """Route toward responsive peers: capable, not in backoff,
+        fewest strikes first, then least loaded. Deterministic
+        tie-break by id so replays schedule identically."""
         candidates = [p for p in self.peers.values()
                       if p.height >= height and
-                      p.num_pending < self.max_pending_per_peer]
+                      p.num_pending < self.max_pending_per_peer and
+                      not p.in_backoff(now)]
         if not candidates:
             return None
-        return min(candidates, key=lambda p: p.num_pending)
+        return min(candidates,
+                   key=lambda p: (p.strikes, p.num_pending, p.id))
 
     def retry_stale_requests(self) -> None:
-        """Reassign timed-out / orphaned requests; drop slow peers."""
+        """Strike peers behind timed-out / slow requests, reassign the
+        work, and evict only peers that struck out — never the last
+        one standing."""
         drop: List[tuple] = []
         with self._lock:
-            now = time.monotonic()
+            now = clock.now_s()
+            struck: Dict[str, str] = {}
             for p in list(self.peers.values()):
-                if p.is_slow():
-                    drop.append((p.id, "slow peer (min recv rate)"))
+                if p.is_slow() and not p.in_backoff(now):
+                    struck[p.id] = "slow peer (min recv rate)"
             for req in self.requests.values():
                 if req.block is not None:
                     continue
-                if req.peer_id == "" or \
-                        now - req.sent_at > PEER_TIMEOUT_S:
-                    if req.peer_id:
-                        drop.append((req.peer_id, "block request timeout"))
+                if req.peer_id and now - req.sent_at > REQUEST_TIMEOUT_S:
+                    struck.setdefault(req.peer_id,
+                                      "block request timeout")
                     req.peer_id = ""
                     req.sent_at = now
+            for peer_id, reason in struck.items():
+                p = self.peers.get(peer_id)
+                if p is None:
+                    continue
+                p.strike(now)
+                if p.strikes >= MAX_STRIKES and len(self.peers) > 1:
+                    drop.append((peer_id, f"{reason} x{p.strikes}"))
         for peer_id, reason in drop:
             self.logger.info("evicting fast-sync peer", peer=peer_id,
                              reason=reason)

@@ -23,6 +23,7 @@ from typing import Optional
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.p2p.conn import ChannelDescriptor
 from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.state.execution import ApplyBlockError
 from tendermint_tpu.types import encoding
 from tendermint_tpu.types.block import Block, BlockID
 
@@ -30,6 +31,16 @@ BLOCKCHAIN_CHANNEL = 0x40
 SYNC_TICK_S = 0.05                # trySyncTicker (blockchain/reactor.go)
 STATUS_UPDATE_INTERVAL_S = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
+MAX_SYNC_RETRIES = 5              # consecutive transient sync-loop errors
+#                                   tolerated before stopping LOUDLY
+SYNC_RETRY_BACKOFF_S = 0.5
+NO_PEER_GRACE_S = 45.0            # a node EXPECTING peers (persistent
+#                                   peers configured) keeps waiting this
+#                                   long through a no-peer window before
+#                                   concluding it is caught up — dial +
+#                                   redial cycles live inside it
+REDIAL_INTERVAL_S = 5.0
+MAX_REDIALS = 3
 VERIFY_WINDOW = 256               # blocks batched per device dispatch:
 #                                   the sweep optimum (~16-32k sigs in
 #                                   flight at 64 validators) — dispatch
@@ -41,14 +52,30 @@ VERIFY_WINDOW = 256               # blocks batched per device dispatch:
 
 class BlockchainReactor(Reactor):
     def __init__(self, state, block_exec, block_store, fast_sync: bool,
-                 consensus_reactor=None, verify_window: int = VERIFY_WINDOW):
+                 consensus_reactor=None, verify_window: int = VERIFY_WINDOW,
+                 gate=None, expect_peers: bool = False, redial=None,
+                 after_apply=None):
+        """`gate`: an optional threading.Event the sync loop waits on
+        before requesting anything — the state-sync restore holds it
+        until the stores are bootstrapped (or the restore fell back).
+        `expect_peers`/`redial`: the bounded-redial discipline — a node
+        with configured peers does NOT conclude "caught up" in a
+        no-peer window; it redials (bounded) and keeps waiting through
+        NO_PEER_GRACE_S. `after_apply(state)`: recovery-plane hook run
+        after each applied block (snapshot manager)."""
         super().__init__("blockchain")
+        from tendermint_tpu.utils.log import get_logger
+        self.logger = get_logger("blockchain")
         self.state = state
         self.block_exec = block_exec
         self.block_store = block_store
         self.fast_sync = fast_sync
         self.consensus_reactor = consensus_reactor
         self.verify_window = verify_window
+        self.gate = gate
+        self.expect_peers = expect_peers
+        self.redial = redial
+        self.after_apply = after_apply
         self.pool = BlockPool(
             start_height=block_store.height() + 1,
             send_request=self._send_block_request,
@@ -57,6 +84,12 @@ class BlockchainReactor(Reactor):
         self._thread: Optional[threading.Thread] = None
         self.synced = not fast_sync
         self.sync_error: Optional[Exception] = None
+        self._peer_heights: dict = {}   # served peers' reported heights
+        #                                 (the pruner's catch-up floor)
+        self._ph_lock = threading.Lock()
+        self._redials = 0
+        self._last_redial = 0.0
+        self._no_peer_since: Optional[float] = None
         # one window in flight on the device while its predecessor
         # applies on the host: (per_block, result_future, valset_hash,
         # part_size) — see _sync_window. The single resolver thread
@@ -99,6 +132,26 @@ class BlockchainReactor(Reactor):
 
     def remove_peer(self, peer, reason) -> None:
         self.pool.remove_peer(peer.id)
+        with self._ph_lock:
+            self._peer_heights.pop(peer.id, None)
+
+    def min_peer_height(self) -> int:
+        """Lowest chain height any connected peer last reported — the
+        pruner must keep blocks above it so lagging peers can still
+        catch up from us. Returns a very large value with no peers (no
+        constraint)."""
+        with self._ph_lock:
+            if not self._peer_heights:
+                return 1 << 62
+            return min(self._peer_heights.values())
+
+    def adopt_restored(self, state) -> None:
+        """A state-sync restore bootstrapped the stores: adopt the
+        restored state as the sync base and fast-forward the pool."""
+        self.state = state
+        self.pool.reset_height(state.last_block_height + 1)
+        self.logger.info("fast-sync resuming above restored snapshot",
+                         height=state.last_block_height)
 
     def _stop_peer(self, peer_id: str, reason: str) -> None:
         if self.switch is None:
@@ -135,6 +188,9 @@ class BlockchainReactor(Reactor):
                 "height": self.block_store.height()})
         elif t == "status_response":
             self.pool.set_peer_height(peer.id, msg["height"])
+            with self._ph_lock:
+                self._peer_heights[peer.id] = max(
+                    self._peer_heights.get(peer.id, 0), msg["height"])
         else:
             self._stop_peer(peer.id, f"unknown blockchain msg {t!r}")
 
@@ -152,9 +208,21 @@ class BlockchainReactor(Reactor):
 
     def _pool_routine(self) -> None:
         """reactor.go:216 poolRoutine: request scheduling + SYNC_LOOP +
-        periodic status broadcasts + caught-up handoff."""
+        periodic status broadcasts + caught-up handoff, with the PR 9
+        failure discipline: transient errors retry (bounded), fatal
+        store/apply divergence still stops LOUDLY, and a node expecting
+        peers rides out no-peer windows with bounded redials instead of
+        prematurely declaring itself caught up."""
+        if self.gate is not None:
+            # state-sync holds the gate until the stores are
+            # bootstrapped (or the restore falls back to block sync)
+            while not self._stopped and not self.gate.wait(timeout=0.2):
+                pass
+            if self._stopped:
+                return
         last_status = 0.0
         last_switch_check = 0.0
+        retries = 0
         while not self._stopped and self.fast_sync:
             now = time.monotonic()
             try:
@@ -164,18 +232,58 @@ class BlockchainReactor(Reactor):
                     last_status = now
                 if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL_S:
                     last_switch_check = now
-                    if self.pool.is_caught_up():
+                    if self._may_switch(now) and self.pool.is_caught_up():
                         self._switch_to_consensus()
                         return
-                if not self._sync_window():
+                if self._sync_window():
+                    retries = 0
+                else:
                     time.sleep(SYNC_TICK_S)
-            except Exception as e:
+            except ApplyBlockError as e:
                 # store/apply divergence is unrecoverable mid-sync (the
                 # reference panics here, consensus/state.go:1214-1220):
                 # stop LOUDLY instead of silently retrying forever
                 self.sync_error = e
                 self.fast_sync = False
                 raise
+            except Exception as e:
+                # anything else (a torn peer conn mid-window, a
+                # transient store hiccup) gets a bounded retry: drop
+                # the in-flight window and re-collect from the pool
+                retries += 1
+                self._pending_window = None
+                if retries > MAX_SYNC_RETRIES:
+                    self.sync_error = e
+                    self.fast_sync = False
+                    raise
+                self.logger.error("fast-sync loop error; retrying",
+                                  attempt=retries, err=repr(e))
+                time.sleep(SYNC_RETRY_BACKOFF_S * retries)
+
+    def _may_switch(self, now: float) -> bool:
+        """Gate premature consensus handoff: with peers connected the
+        pool's own frontier check decides; in a no-peer window a node
+        that EXPECTS peers first rides out NO_PEER_GRACE_S, redialing
+        its configured peers a bounded number of times."""
+        if self.pool.num_peers() > 0:
+            self._no_peer_since = None
+            self._redials = 0
+            return True
+        if not self.expect_peers:
+            return True
+        if self._no_peer_since is None:
+            self._no_peer_since = now
+        if self.redial is not None and self._redials < MAX_REDIALS and \
+                now - self._last_redial > REDIAL_INTERVAL_S:
+            self._redials += 1
+            self._last_redial = now
+            self.logger.info("fast-sync has no peers: redialing",
+                             attempt=self._redials)
+            try:
+                self.redial()
+            except Exception as e:
+                self.logger.error("redial failed", err=repr(e))
+        return now - self._no_peer_since >= NO_PEER_GRACE_S
 
     def broadcast_status_request(self) -> None:
         if self.switch is not None:
@@ -281,6 +389,11 @@ class BlockchainReactor(Reactor):
                 self.state, block_id, block, trust_last_commit=True)
             self.pool.pop_request()
             applied += 1
+            if self.after_apply is not None:
+                # recovery plane: interval snapshots + pruning fire on
+                # the sync path too (the app sits at exactly this
+                # height until the next iteration applies)
+                self.after_apply(self.state)
         return applied
 
     def _sync_window(self) -> bool:

@@ -113,6 +113,22 @@ class ChaosNet:
         self._archive: Dict[int, List[tuple]] = {}  # height -> [(src, msg)]
         self._last_assist: Dict[int, int] = {}
         self.assists = 0
+        # same-height stall assist (spec key "stall_assist", default
+        # OFF): the real consensus reactor re-gossips current-height
+        # votes continuously, so a dropped vote is only DELAYED on a
+        # live network. The relay's drops are final, which can wedge
+        # every node at one height with no timeout pending (each
+        # waiting for a vote nobody will resend). When opted in and the
+        # frontier stalls, the archived traffic for the height being
+        # decided is re-delivered to every live node — deterministic
+        # (step-scheduled), and duplicate votes are no-ops. Off by
+        # default because re-delivery changes a seeded trajectory
+        # (including re-surfacing byzantine twins), and the committed
+        # artifact scenarios are pinned to theirs.
+        self.stall_assist = bool((spec or {}).get("stall_assist"))
+        self._frontier = 0
+        self._frontier_step = 0
+        self._last_stall_assist = 0
         self.nodes: List[Optional[object]] = [None] * n
         self._t0 = time.perf_counter()
         for i in range(n):
@@ -327,10 +343,38 @@ class ChaosNet:
 
     def _assist(self) -> None:
         """Reactor-style catch-up for nodes behind the committed
-        frontier (see module docstring)."""
+        frontier (see module docstring), plus the same-height stall
+        assist for a frontier that stopped moving."""
         t = self.t
         frontier = max((self._height(i) for i in range(self.n)
                         if self.nodes[i] is not None), default=0)
+        if frontier > self._frontier:
+            self._frontier = frontier
+            self._frontier_step = t
+        elif self.stall_assist and \
+                t - self._frontier_step >= 6 * self.assist_every and \
+                t - self._last_stall_assist >= 3 * self.assist_every:
+            # last-resort threshold, well past crash downtimes and
+            # partition windows
+            self._last_stall_assist = t
+            msgs = self._archive.get(frontier + 1, [])
+            if msgs:
+                self.assists += 1
+                ordered = ([m for m in msgs if m[1]["type"] == "vote"]
+                           + [m for m in msgs
+                              if m[1]["type"] == "proposal"]
+                           + [m for m in msgs
+                              if m[1]["type"] == "block_part"])
+                for i, node in enumerate(self.nodes):
+                    if node is None:
+                        continue
+                    for src, m in ordered:
+                        if src == i:
+                            continue
+                        self._interact(
+                            i, lambda n=node, mm=m, s=src:
+                            n.consensus.submit(dict(mm),
+                                               peer_id=f"stall{s}"))
         for i, node in enumerate(self.nodes):
             if node is None or self._height(i) >= frontier:
                 continue

@@ -40,7 +40,7 @@ from __future__ import annotations
 import random
 from typing import Dict, List, Optional
 
-from tendermint_tpu.utils.fail import COMMIT_POINTS
+from tendermint_tpu.utils.fail import COMMIT_POINTS, RECOVERY_POINTS
 
 _RATE_KEYS = ("drop", "delay", "duplicate", "reorder")
 
@@ -60,10 +60,10 @@ class FaultSchedule:
         self.crashes = [dict(c) for c in spec.get("crashes", ())]
         for c in self.crashes:
             point = c.setdefault("point", COMMIT_POINTS[0])
-            if point not in COMMIT_POINTS:
+            if point not in COMMIT_POINTS + RECOVERY_POINTS:
                 raise ValueError(
                     f"unknown crash point {point!r} "
-                    f"(known: {COMMIT_POINTS})")
+                    f"(known: {COMMIT_POINTS + RECOVERY_POINTS})")
             c.setdefault("down_steps", 20)
             c.setdefault("after_height", 1)
         self.clock_skew: Dict[int, int] = {

@@ -83,6 +83,10 @@ def cmd_node(args) -> int:
                     f.write(f"{100*c/total:6.2f}% {name} <- {caller} "
                             f"({fn})\n")
         atexit.register(_dump)
+    if getattr(args, "state_sync", False):
+        # env wins over config everywhere the knob plane reads — the
+        # flag is sugar for exporting it before Node construction
+        os.environ["TM_TPU_STATE_SYNC"] = "on"
     node = default_node(args.home, app=app, with_p2p=args.p2p,
                         fast_sync=(args.fast_sync if args.p2p else False))
     if args.p2p_laddr:
@@ -381,6 +385,8 @@ def main(argv=None) -> int:
                     help="serve the gRPC BroadcastAPI on this address")
     sp.add_argument("--persistent-peers", default="",
                     help="comma-separated id@host:port")
+    sp.add_argument("--state-sync", action="store_true",
+                    help="join via p2p snapshot restore (fresh nodes)")
     sp.set_defaults(fn=cmd_node)
 
     sp = sub.add_parser("testnet",

@@ -67,6 +67,20 @@ class BaseConfig:
     # (which may carry its own seed=N) wins over both.
     chaos: str = "off"
     chaos_seed: int = 0
+    # recovery plane (storage/snapshot.py + statesync/): chunked state
+    # snapshots every `snapshot_interval` heights (0 = off), newest
+    # `snapshot_keep` retained; `retain_heights` > 0 prunes block/state
+    # stores behind the combined floor (never below the latest
+    # snapshot, the evidence horizon, or a peer's catch-up frontier);
+    # `state_sync` lets a fresh node join by fetching a snapshot over
+    # p2p instead of replaying every block. TM_TPU_SNAPSHOT_INTERVAL /
+    # _KEEP / _CHUNK_KB, TM_TPU_RETAIN_HEIGHTS and TM_TPU_STATE_SYNC
+    # win over these; everything 0/off = today's behavior byte-for-byte.
+    snapshot_interval: int = 0
+    snapshot_keep: int = 2
+    snapshot_chunk_kb: int = 256
+    retain_heights: int = 0
+    state_sync: bool = False
 
 
 @dataclass

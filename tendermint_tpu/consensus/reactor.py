@@ -75,10 +75,19 @@ class PeerRoundState:
                 self.proposal_parts_total = 0
                 self.proposal_pol_round = -1
             if self.height != prev_height:
-                # drop stale vote knowledge for older heights
-                self.votes_known = {
-                    k: v for k, v in self.votes_known.items()
-                    if k[0] >= self.height - 1}
+                # drop ALL vote knowledge on a height change (the
+                # reference re-allocates fresh bitmaps in
+                # ApplyNewRoundStepMessage). Keeping marks for the new
+                # height wedged rejoining nodes: while a peer
+                # fast-syncs, its consensus reactor DROPS every gossiped
+                # vote, but our send path had already marked them known
+                # — once the peer announced the snapshot/sync frontier
+                # height, the commit votes it needed were never resent
+                # and it sat in PREVOTE forever. Starting from zero
+                # costs at most one duplicate commit's worth of votes
+                # (VoteSet dedups); the peer's own has_vote
+                # announcements rebuild the map immediately.
+                self.votes_known = {}
         # set AFTER the state write: a waiter that consumed the wake
         # and re-scanned before the write would otherwise see stale
         # state and park through the whole idle backstop
@@ -89,6 +98,17 @@ class PeerRoundState:
         with self.lock:
             self.votes_known.setdefault((height, round_, type_),
                                         set()).add(index)
+
+    def forget_height(self, height: int) -> None:
+        """Self-healing for catchup gossip: marks recorded while the
+        peer was fast-syncing (its reactor drops every vote/part on
+        the floor) are lies. When the peer sits at `height` with
+        nothing left to send, forget what we think it has and resend —
+        VoteSet/PartSet dedup the genuine duplicates."""
+        with self.lock:
+            self.votes_known = {k: v for k, v in self.votes_known.items()
+                                if k[0] != height}
+            self.proposal_parts = set()
 
     def known_votes(self, height: int, round_: int, type_: int) -> set:
         with self.lock:
@@ -493,6 +513,8 @@ class ConsensusReactor(Reactor):
 
     def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
         """consensus/reactor.go:604 gossipVotesRoutine."""
+        catchup_idle = 0   # iterations a catchup peer sat with nothing
+        #                    sendable — triggers the mark self-heal
         while self._peer_alive(peer):
             if self.fast_sync:
                 ps.wake.wait(self.gossip_sleep_s)
@@ -545,7 +567,22 @@ class ConsensusReactor(Reactor):
                     v = vote_msg["vote"]
                     ps.set_has_vote(v["height"], v["round"], v["type"],
                                     v["validator_index"])
+                catchup_idle = 0
                 continue
+            if catchup_height:
+                # a peer STUCK behind us with nothing left to send:
+                # our marks may predate its fast-sync handoff (votes
+                # we "sent" were dropped unprocessed). After ~2s of
+                # idling, forget the height's marks and resend — the
+                # un-wedge for a rejoining node whose sync frontier
+                # landed exactly on its boot-announced height.
+                catchup_idle += 1
+                if catchup_idle * self.gossip_sleep_s >= 2.0:
+                    catchup_idle = 0
+                    ps.forget_height(catchup_height)
+                    continue
+            else:
+                catchup_idle = 0
             ps.wake.wait(self.gossip_sleep_s)
             ps.wake.clear()
 

@@ -113,11 +113,18 @@ def catchup_replay(cs, wal) -> int:
 
 class Handshaker:
     def __init__(self, state_store, block_store, gen_doc,
-                 verifier=None):
+                 verifier=None, snapshot_store=None, app=None):
+        """`snapshot_store`/`app`: the recovery plane's local-snapshot
+        seam. A pruned store (or one bootstrapped by state sync) no
+        longer holds every block an in-memory app needs for replay; the
+        handshake then rebuilds the app from the newest PINNED local
+        snapshot and replays only the blocks above it."""
         self.state_store = state_store
         self.block_store = block_store
         self.gen_doc = gen_doc
         self.verifier = verifier
+        self.snapshot_store = snapshot_store
+        self.app = app
         self.n_blocks = 0
 
     def handshake(self, app_conns) -> State:
@@ -156,6 +163,29 @@ class Handshaker:
 
         if store_height == 0:
             return state
+
+        # recovery plane: blocks below the store's base were pruned (or
+        # never stored — a state-sync bootstrap). An app behind the
+        # base cannot be replayed forward from blocks; rebuild it from
+        # the newest pinned local snapshot, then replay only the tail.
+        base = self.block_store.base() \
+            if hasattr(self.block_store, "base") else 1
+        if app_height + 1 < base:
+            restored = None
+            if self.snapshot_store is not None:
+                from tendermint_tpu.storage.snapshot import (
+                    restore_app_locally)
+                restored = restore_app_locally(
+                    self.snapshot_store, self.state_store, self.app,
+                    store_height)
+            if restored is None or restored[0] + 1 < base:
+                raise HandshakeError(
+                    f"app at {app_height} needs blocks from "
+                    f"{app_height + 1} but the store was pruned to base "
+                    f"{base} and no usable local snapshot covers the "
+                    "gap")
+            app_height, app_hash = restored
+            self.n_blocks += 1  # the snapshot restore counts as one step
 
         if store_height == state_height:
             # consensus committed + applied the block but the app may have

@@ -96,6 +96,12 @@ class ConsensusState:
 
         self.broadcast_hooks: List[Callable[[dict], None]] = []
         self.decided_hook: Optional[Callable[[Block], None]] = None
+        # recovery plane: called with the POST-apply State after each
+        # finalized height, while the app still sits at exactly that
+        # height (node.py wires the snapshot manager here). A hook
+        # failure is logged, never raised — snapshots are an amenity,
+        # consensus is not.
+        self.post_commit_hooks: List[Callable[[State], None]] = []
 
         self._lock = threading.RLock()
         self._queue: deque = deque()
@@ -864,6 +870,7 @@ class ConsensusState:
 
         if self.decided_hook is not None:
             self.decided_hook(block)
+        self._run_post_commit_hooks(new_state)
 
         if telemetry.enabled() and not self.replay_mode:
             _m_commits.inc()
@@ -876,6 +883,18 @@ class ConsensusState:
 
         self._update_to_state(new_state)
         self._schedule_round0()
+
+    def _run_post_commit_hooks(self, new_state) -> None:
+        for hook in self.post_commit_hooks:
+            try:
+                hook(new_state)
+            except Exception as e:
+                # the chaos plane's ChaosCrash is a BaseException and
+                # passes through — a SIMULATED crash in a snapshot fail
+                # point must still kill the node
+                self.logger.error("post-commit hook failed",
+                                  height=new_state.last_block_height,
+                                  err=repr(e))
 
     def _finalize_commit_pipelined(self, height: int, block, parts,
                                    pc) -> None:
@@ -933,6 +952,7 @@ class ConsensusState:
 
         if self.decided_hook is not None:
             self.decided_hook(block)
+        self._run_post_commit_hooks(new_state)
 
         if telemetry.enabled() and not self.replay_mode:
             _m_commits.inc()

@@ -91,6 +91,39 @@ class Node:
         self.block_store = BlockStore(open_db(db_path("blockstore")))
         self.state_store = StateStore(open_db(db_path("state")))
 
+        # recovery plane knobs (env > config > default; all-zero/off =
+        # the pre-snapshot behavior byte-for-byte). In-memory nodes
+        # have no home to keep snapshot files in — plane disabled.
+        from tendermint_tpu.utils import knobs as _knobs
+        self._snap_interval = _knobs.knob_int(
+            "TM_TPU_SNAPSHOT_INTERVAL",
+            config=getattr(config.base, "snapshot_interval", 0))
+        self._snap_keep = _knobs.knob_int(
+            "TM_TPU_SNAPSHOT_KEEP",
+            config=getattr(config.base, "snapshot_keep", 2), default=2)
+        self._snap_chunk_kb = _knobs.knob_int(
+            "TM_TPU_SNAPSHOT_CHUNK_KB",
+            config=getattr(config.base, "snapshot_chunk_kb", 256),
+            default=256)
+        self._retain_heights = _knobs.knob_int(
+            "TM_TPU_RETAIN_HEIGHTS",
+            config=getattr(config.base, "retain_heights", 0))
+        self._state_sync = _knobs.knob_bool(
+            "TM_TPU_STATE_SYNC",
+            config=getattr(config.base, "state_sync", False))
+        self.snapshot_store = None
+        self._statesync_dir = ""
+        if not in_memory:
+            from tendermint_tpu.storage import SnapshotStore
+            data_dir = config.path(config.base.db_dir)
+            self.snapshot_store = SnapshotStore(
+                os.path.join(data_dir, "snapshots"))
+            self._statesync_dir = os.path.join(data_dir, "statesync")
+        else:
+            self._snap_interval = 0
+            self._retain_heights = 0
+            self._state_sync = False
+
         if client_creator is None:
             if app is None:
                 from tendermint_tpu.abci.apps import KVStoreApp
@@ -127,9 +160,22 @@ class Node:
                 vb, mesh=vm, coalesce=vc, coalesce_wait_ms=vc_wait,
                 coalesce_max_batch=vc_max or None)
 
+        # a state-sync restore a crash tore mid-apply is repaired HERE,
+        # before the handshake reads the stores (the apply is
+        # idempotent; incomplete downloads are left for the reactor)
+        if self._statesync_dir and os.path.isdir(self._statesync_dir) \
+                and self.app is not None:
+            from tendermint_tpu.statesync import resume_pending_restore
+            resume_pending_restore(
+                self._statesync_dir, self.block_store, self.state_store,
+                self.snapshot_store, self.app, gen_doc.chain_id,
+                verifier=self.verifier, logger=self.logger)
+
         # ABCI handshake: sync app with stores (consensus/replay.go:211)
         handshaker = Handshaker(self.state_store, self.block_store, gen_doc,
-                                verifier=self.verifier)
+                                verifier=self.verifier,
+                                snapshot_store=self.snapshot_store,
+                                app=self.app)
         state = handshaker.handshake(self.app_conns)
 
         if mempool is None:
@@ -171,6 +217,21 @@ class Node:
         if hasattr(mempool, "txs_available_hook"):
             mempool.txs_available_hook = lambda: self.consensus.submit(
                 {"type": "txs_available"})
+
+        # recovery plane: interval snapshots + retention + pruning on
+        # the commit path (and, below, on the fast-sync apply path)
+        self.snapshots = None
+        if self.snapshot_store is not None and \
+                (self._snap_interval > 0 or self._retain_heights > 0):
+            from tendermint_tpu.storage import SnapshotManager
+            self.snapshots = SnapshotManager(
+                self.snapshot_store, self.state_store, self.block_store,
+                self.app, interval=self._snap_interval,
+                keep=self._snap_keep,
+                chunk_size=self._snap_chunk_kb * 1024,
+                retain_heights=self._retain_heights)
+            self.consensus.post_commit_hooks.append(
+                self.snapshots.maybe_snapshot)
 
         # ------------------------------------------------ p2p reactor stack
         self.switch = None
@@ -233,17 +294,54 @@ class Node:
         self.consensus_reactor = ConsensusReactor(
             self.consensus, fast_sync=fast_sync,
             gossip_sleep_s=self.config.consensus.peer_gossip_sleep_ms / 1e3)
+        # state sync only engages on a node with NOTHING below it: a
+        # genesis-fresh store joining an established chain
+        restore = bool(self._state_sync and fast_sync and
+                       self.snapshot_store is not None and
+                       self.app is not None and
+                       state.last_block_height == 0)
+        self._statesync_gate = None
+        if restore:
+            import threading as _threading
+            self._statesync_gate = _threading.Event()
+        expect_peers = bool(self.config.p2p.persistent_peers or
+                            self.config.p2p.seeds)
         self.blockchain_reactor = BlockchainReactor(
             state, self.block_exec, self.block_store, fast_sync=fast_sync,
-            consensus_reactor=self.consensus_reactor)
+            consensus_reactor=self.consensus_reactor,
+            gate=self._statesync_gate, expect_peers=expect_peers,
+            redial=self._dial_configured_peers,
+            after_apply=(self.snapshots.maybe_snapshot
+                         if self.snapshots is not None else None))
+        if self.snapshots is not None:
+            reactor = self.blockchain_reactor
+            self.snapshots.peer_floor = \
+                lambda: reactor.min_peer_height() + 1
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=self.config.mempool.broadcast)
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        self.statesync_reactor = None
+        if self.snapshot_store is not None and \
+                (restore or self._snap_interval > 0):
+            # the channel is only advertised when the recovery plane is
+            # on — peers without it never see 0x60 traffic (try_send
+            # checks the remote's advertised channels)
+            from tendermint_tpu.statesync import StateSyncReactor
+            self.statesync_reactor = StateSyncReactor(
+                self.snapshot_store, self.gen_doc.chain_id,
+                restore=restore, statesync_dir=self._statesync_dir,
+                block_store=self.block_store,
+                state_store=self.state_store, app=self.app,
+                verifier=self.verifier,
+                on_restored=self._on_state_sync_done)
 
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
+        if self.statesync_reactor is not None:
+            self.switch.add_reactor("statesync", self.statesync_reactor)
 
         from tendermint_tpu.p2p.trust import TrustMetricStore
         from tendermint_tpu.storage import open_db as _open
@@ -322,6 +420,22 @@ class Node:
                 self.grpc_server.start()
                 self.logger.info("grpc broadcast api listening",
                                  port=self.grpc_server.port)
+
+    def _on_state_sync_done(self, state) -> None:
+        """State-sync restore concluded. On success every store is
+        bootstrapped at the snapshot height — adopt the state across
+        the node's live components; either way, release the fast-sync
+        gate so block sync proceeds (from the snapshot, or from
+        genesis on fallback)."""
+        if state is not None:
+            self.consensus.state = state
+            self.blockchain_reactor.adopt_restored(state)
+            self.evidence_pool.state = state
+            self.mempool.update(state.last_block_height, [])
+            self.logger.info("state sync complete; fast-syncing tail",
+                             height=state.last_block_height)
+        if self._statesync_gate is not None:
+            self._statesync_gate.set()
 
     def _dial_configured_peers(self) -> None:
         from tendermint_tpu.p2p import NetAddress

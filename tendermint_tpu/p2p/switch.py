@@ -386,16 +386,19 @@ class Switch:
 
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """switch.go StopPeerForError + reconnect for persistent peers."""
-        if not self._stopped:
+        stale = self.peers.get(peer.id) is not peer
+        if not self._stopped and not stale:
             # during Switch.stop() the conn-close races are expected;
             # an "error" log (or a trust penalty) from a dying recv
-            # thread would smear well-behaved peers on every shutdown
+            # thread — or from a conn the dial tiebreak already
+            # replaced — would smear well-behaved peers
             self.logger.error("stopping peer for error", peer=peer.id,
                               err=reason)
             if self.trust_store is not None:
                 self.trust_store.get_metric(peer.id).bad_events(1)
         self._remove_peer(peer, reason)
         if peer.persistent and peer.dial_addr is not None and \
+                not stale and \
                 not self._stopped:
             threading.Thread(target=self._reconnect_to_peer,
                              args=(peer.dial_addr,), daemon=True).start()
@@ -404,7 +407,18 @@ class Switch:
         self._remove_peer(peer, None)
 
     def _remove_peer(self, peer: Peer, reason, join: bool = False) -> None:
-        if not self.peers.has(peer.id):
+        registered = self.peers.get(peer.id)
+        if registered is None:
+            return
+        if registered is not peer:
+            # a DIFFERENT connection owns this id now (the simultaneous-
+            # dial tiebreak replaced this one). A late error from the
+            # replaced conn's recv thread must only close ITS socket —
+            # notifying reactors here would deregister the LIVE peer
+            # from the fast-sync pool and the consensus gossip state by
+            # id (the killed-node rejoin flake: the pool lost its only
+            # peer right after re-registration and dead-ended)
+            peer.stop(join=join)
             return
         self.peers.remove(peer)
         _m_peers.set(self.peers.size())

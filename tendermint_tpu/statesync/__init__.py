@@ -1,0 +1,15 @@
+"""State sync — snapshot discovery, transfer and restore over p2p.
+
+  reactor.py   StateSyncReactor (channel 0x60): advertises + serves
+               local snapshots, and on a joining node fetches the best
+               offered snapshot chunk-by-chunk from multiple peers in
+               parallel, verifies everything, and bootstraps the
+               stores so fast-sync only replays the tail.
+"""
+
+from tendermint_tpu.statesync.reactor import (
+    STATESYNC_CHANNEL,
+    StateSyncReactor,
+    apply_restore,
+    resume_pending_restore,
+)

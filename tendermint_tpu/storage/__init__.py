@@ -5,11 +5,14 @@
   state_store.py  state + historical valsets/params (state/store.go)
   wal.py          CRC-framed write-ahead log with ENDHEIGHT markers
                   (consensus/wal.go)
+  snapshot.py     chunked state snapshots + retention + pruning
+                  orchestration (the recovery plane)
 """
 
 from tendermint_tpu.storage.db import KVStore, MemDB, SQLiteDB, open_db
 from tendermint_tpu.storage.block_store import BlockMeta, BlockStore
 from tendermint_tpu.storage.state_store import StateStore
+from tendermint_tpu.storage.snapshot import SnapshotManager, SnapshotStore
 from tendermint_tpu.storage.wal import (
     WAL, NilWAL, WALMessage, EndHeightMessage, WALCorruptionError,
 )

@@ -17,6 +17,7 @@ from tendermint_tpu.types.block import Block, BlockID, Commit, Header
 from tendermint_tpu.types.part_set import Part, PartSet
 
 _HEIGHT_KEY = b"BS:height"
+_BASE_KEY = b"BS:base"       # first retained height (pruning floor + 0)
 
 
 def _meta_key(h: int) -> bytes:
@@ -89,6 +90,76 @@ class BlockStore:
     def height(self) -> int:
         raw = self.db.get(_HEIGHT_KEY)
         return 0 if raw is None else int(raw)
+
+    def base(self) -> int:
+        """First height whose block is retained (blocks below were
+        pruned, or — after a state-sync bootstrap — never stored).
+        1 on an unpruned store.
+
+        SELF-HEALING against a torn prune: each prune window's deletes
+        commit strictly BEFORE the base row advances, so a crash
+        mid-range can leave the row pointing at already-deleted
+        heights. Scan forward to the first retained block and repair
+        the row (bounded by one prune window per crash)."""
+        raw = self.db.get(_BASE_KEY)
+        b = 1 if raw is None else int(raw)
+        h = self.height()
+        healed = b
+        while healed <= h and self.db.get(_meta_key(healed)) is None:
+            healed += 1
+        if healed != b:
+            self.db.set(_BASE_KEY, b"%d" % healed)
+        return healed
+
+    def bootstrap(self, height: int, seen_commit: Commit) -> None:
+        """State-sync bootstrap: adopt `height` as the store frontier
+        WITHOUT any blocks below it. Stores the snapshot height's seen
+        commit (consensus `_reconstruct_last_commit` needs it at the
+        fast-sync handoff) and sets base = height + 1 — the first block
+        this store will ever hold is the snapshot's successor. One
+        atomic batch; idempotent, so a torn state-sync apply can simply
+        re-run it."""
+        if self.height() > height:
+            raise ValueError(
+                f"bootstrap at {height} behind existing store height "
+                f"{self.height()}")
+        self.db.set_batch([
+            (_seen_commit_key(height), seen_commit.to_bytes()),
+            (_commit_key(height), seen_commit.to_bytes()),
+            (_BASE_KEY, b"%d" % (height + 1)),
+            (_HEIGHT_KEY, b"%d" % height),
+        ])
+
+    def prune(self, retain_height: int, window: int = 256) -> int:
+        """Delete blocks below `retain_height` (meta, parts, commits,
+        seen commits), one delete_batch per `window` heights — group
+        commit for the delete path. The base row advances AFTER each
+        window's deletes commit, so a crash mid-range leaves only
+        already-deleted rows below base: the next prune re-issues
+        idempotent deletes. Returns the number of heights pruned.
+        Callers enforce the floor policy (snapshot / evidence / peer
+        frontiers) — this is the mechanism only."""
+        from tendermint_tpu.utils import fail
+        base = self.base()
+        retain_height = min(retain_height, self.height())
+        if retain_height <= base:
+            return 0
+        pruned = 0
+        for lo in range(base, retain_height, window):
+            hi = min(lo + window, retain_height)
+            keys = []
+            for h in range(lo, hi):
+                meta = self.load_block_meta(h)
+                n_parts = meta.block_id.parts.total if meta else 0
+                keys.append(_meta_key(h))
+                keys.extend(_part_key(h, i) for i in range(n_parts))
+                keys.append(_commit_key(h))
+                keys.append(_seen_commit_key(h))
+            self.db.delete_batch(keys)
+            fail.fail_point("prune.mid_range")
+            self.db.set(_BASE_KEY, b"%d" % hi)
+            pruned += hi - lo
+        return pruned
 
     def save_block(self, block: Block, part_set: PartSet,
                    seen_commit: Commit) -> None:

@@ -19,7 +19,9 @@ class KVStore(Protocol):
     def set(self, key: bytes, value: bytes) -> None: ...
     def set_batch(self, pairs: Sequence[tuple[bytes, bytes]]) -> None: ...
     def delete(self, key: bytes) -> None: ...
+    def delete_batch(self, keys: Sequence[bytes]) -> None: ...
     def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]: ...
+    def compact(self) -> None: ...
     def close(self) -> None: ...
 
 
@@ -56,11 +58,19 @@ class MemDB:
         with self._lock:
             self._d.pop(key, None)
 
+    def delete_batch(self, keys: Sequence[bytes]) -> None:
+        with self._lock:
+            for k in keys:
+                self._d.pop(k, None)
+
     def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
         with self._lock:
             items = sorted((k, v) for k, v in self._d.items()
                            if k.startswith(prefix))
         yield from items
+
+    def compact(self) -> None:
+        pass
 
     def close(self) -> None:
         pass
@@ -112,6 +122,15 @@ class SQLiteDB:
         con.execute("DELETE FROM kv WHERE k=?", (key,))
         con.commit()
 
+    def delete_batch(self, keys: Sequence[bytes]) -> None:
+        """One transaction for a whole range of deletions — the pruning
+        hot path issues one of these per height window instead of a
+        commit per row."""
+        con = self._con()
+        con.executemany("DELETE FROM kv WHERE k=?",
+                        [(bytes(k),) for k in keys])
+        con.commit()
+
     def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
         hi = _prefix_upper_bound(prefix) if prefix else None
         if prefix and hi is not None:
@@ -124,6 +143,14 @@ class SQLiteDB:
         else:
             cur = self._con().execute("SELECT k, v FROM kv ORDER BY k")
         yield from cur
+
+    def compact(self) -> None:
+        """Reclaim the space deleted rows leave behind — sqlite keeps
+        freed pages in the file until a VACUUM rewrites it. Called by
+        the pruner after a range delete; safe at any quiescent point
+        (VACUUM cannot run inside a transaction, and every write here
+        commits immediately)."""
+        self._con().execute("VACUUM")
 
     def close(self) -> None:
         # close EVERY thread's connection, not just the caller's —
@@ -173,6 +200,10 @@ class StagedDB:
     def delete(self, key: bytes) -> None:
         self.staged[bytes(key)] = None
 
+    def delete_batch(self, keys: Sequence[bytes]) -> None:
+        for k in keys:
+            self.staged[bytes(k)] = None
+
     def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
         over = {k: v for k, v in self.staged.items() if k.startswith(prefix)}
         for k, v in self.inner.iterate(prefix):
@@ -183,18 +214,22 @@ class StagedDB:
             if over[k] is not None:
                 yield k, over[k]
 
+    def compact(self) -> None:
+        pass  # view only; compaction belongs to the inner store
+
     def close(self) -> None:
         pass  # view only; the inner store's owner closes it
 
     def flush_into_inner(self) -> None:
         """Apply the overlay to the inner store: one set_batch for every
-        staged write, then any staged deletions. Clears the overlay."""
+        staged write, then one delete_batch for every staged deletion.
+        Clears the overlay."""
         sets = [(k, v) for k, v in self.staged.items() if v is not None]
         dels = [k for k, v in self.staged.items() if v is None]
         if sets:
             self.inner.set_batch(sets)
-        for k in dels:
-            self.inner.delete(k)
+        if dels:
+            self.inner.delete_batch(dels)
         self.staged.clear()
 
 

@@ -65,11 +65,14 @@ from tendermint_tpu.utils import knobs
 #   p2p.recv         receive-side wire link span (carries origin+send ts)
 #   mempool.recv     tx-gossip batch receive link span
 #   stall            stall detector fired (flight recorder)
+#   snapshot.restore state-sync restore apply (assemble/verify/bootstrap)
+#   sync.chunk       one verified snapshot chunk landed (origin + bytes)
 SPAN_CATALOG = frozenset((
     "height.begin", "propose", "proposal.recv", "part.first",
     "block.full", "quorum.prevote", "quorum.precommit",
     "verify.dispatch", "apply", "flush", "wal.fsync", "commit",
     "p2p.recv", "mempool.recv", "stall",
+    "snapshot.restore", "sync.chunk",
 ))
 
 DEFAULT_CAPACITY = 65536

@@ -31,6 +31,15 @@ def now_ns() -> int:
     return time.time_ns()
 
 
+def now_s() -> float:
+    """The same sanctioned clock in seconds — for retry/backoff
+    schedules that must follow chaos skew and replay deterministically
+    (fast-sync peer backoff, state-sync chunk timeouts). Pure interval
+    math with no replay/skew requirement should keep using
+    time.monotonic()."""
+    return now_ns() / 1e9
+
+
 def set_source(source: Optional[Callable[[], int]]) -> None:
     """Install a replacement nanosecond source (None restores the real
     clock). Chaos clock-skew and deterministic replay hook in here."""

@@ -58,6 +58,21 @@ COMMIT_POINTS = (
     "consensus.after_apply_block",
 )
 
+# The recovery plane's fail points (PR 9): snapshot publication, the
+# state-sync restore apply, and the pruning sweep. They live OUTSIDE
+# the per-commit order above — snapshots/pruning fire only on interval
+# heights and restores only on a joining node — so they get their own
+# catalog rather than perturbing the commit-order sweeps; chaos crash
+# specs and the snapshot recovery sweep reference them by these names.
+RECOVERY_POINTS = (
+    "snapshot.after_chunk",       # each chunk file written (pre-publish)
+    "snapshot.before_publish",    # complete temp dir built, not renamed
+    "statesync.before_apply",     # all chunks verified, stores untouched
+    "statesync.after_restore",    # stores bootstrapped, dir not converted
+    "prune.mid_range",            # one delete window committed, base not
+    #                               yet advanced past the rest
+)
+
 # The same points in SERIAL order (TM_TPU_PIPELINE=off): save_block
 # commits immediately, ENDHEIGHT is fsynced BEFORE ApplyBlock, and the
 # group-flush brackets do not exist on this path.

@@ -119,6 +119,29 @@ CATALOG: tuple[Knob, ...] = (
          "for this many seconds dumps timeline + consensus state "
          "(flight recorder).",
          "node.py"),
+    # -- recovery plane ----------------------------------------------------
+    Knob("TM_TPU_SNAPSHOT_INTERVAL", "int", "0 (off)",
+         "base.snapshot_interval",
+         "Publish a chunked state snapshot every N heights; 0 disables "
+         "the whole snapshot/prune plane.",
+         "storage/snapshot.py"),
+    Knob("TM_TPU_SNAPSHOT_KEEP", "int", "2", "base.snapshot_keep",
+         "How many newest snapshots to retain on disk.",
+         "storage/snapshot.py"),
+    Knob("TM_TPU_SNAPSHOT_CHUNK_KB", "int", "256",
+         "base.snapshot_chunk_kb",
+         "Snapshot chunk size in KiB (content-addressed transfer unit).",
+         "storage/snapshot.py"),
+    Knob("TM_TPU_RETAIN_HEIGHTS", "int", "0 (keep all)",
+         "base.retain_heights",
+         "Prune block/state stores to the newest N heights — floored "
+         "at the latest snapshot, the evidence horizon, and any peer's "
+         "catch-up frontier.",
+         "storage/snapshot.py"),
+    Knob("TM_TPU_STATE_SYNC", "bool", "off", "base.state_sync",
+         "A fresh node joins via p2p snapshot restore (statesync/) and "
+         "fast-syncs only the tail; off = full block replay.",
+         "statesync/reactor.py"),
     # -- chaos plane -------------------------------------------------------
     Knob("TM_TPU_CHAOS", "spec", "off", "base.chaos",
          "Link fault spec, e.g. drop=0.05,delay=0.1,delay_ms=30,seed=7.",

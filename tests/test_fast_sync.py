@@ -106,6 +106,93 @@ def test_pool_peer_removal_reassigns():
     assert ("peerB", 1) in sent
 
 
+def test_pool_timeout_strikes_backoff_and_reroute():
+    """A timed-out request strikes its peer (exponential backoff with
+    deterministic jitter) and reroutes to a responsive peer; only
+    MAX_STRIKES consecutive failures evict."""
+    from tendermint_tpu.blockchain import pool as bpool
+    from tendermint_tpu.utils import clock
+    t = [1000.0]
+    clock.set_source(lambda: int(t[0] * 1e9))
+    try:
+        sent, dropped = [], []
+        pool = BlockPool(1, lambda p, h: sent.append((p, h)) or True,
+                         lambda p, r: dropped.append(p))
+        pool.set_peer_height("peerA", 10)
+        pool.make_next_requests()
+        assert all(p == "peerA" for p, _ in sent)
+        # second peer appears; peerA times out -> strike + backoff,
+        # its heights reassigned to peerB
+        pool.set_peer_height("peerB", 10)
+        t[0] += bpool.REQUEST_TIMEOUT_S + 1
+        pool.retry_stale_requests()
+        a = pool.peers["peerA"]
+        assert a.strikes == 1 and a.in_backoff(clock.now_s())
+        assert {p for p, _ in sent[10:]} == {"peerB"}
+        assert dropped == []              # one strike never evicts
+        # deterministic jitter: same (peer, strike) -> same backoff
+        assert bpool._jitter("peerA", 1) == bpool._jitter("peerA", 1)
+        assert a.backoff_until > clock.now_s()
+        # strikes 2 and 3: now (with another peer present) evicted
+        for _ in range(bpool.MAX_STRIKES - 1):
+            for req in pool.requests.values():
+                req.peer_id = "peerA"   # force re-assignment to peerA
+                req.sent_at = t[0]
+            t[0] += bpool.REQUEST_TIMEOUT_S + bpool.BACKOFF_CAP_S + 1
+            pool.retry_stale_requests()
+        assert dropped == ["peerA"]
+    finally:
+        clock.set_source(None)
+
+
+def test_pool_never_evicts_last_peer():
+    from tendermint_tpu.blockchain import pool as bpool
+    from tendermint_tpu.utils import clock
+    t = [1000.0]
+    clock.set_source(lambda: int(t[0] * 1e9))
+    try:
+        dropped = []
+        pool = BlockPool(1, lambda p, h: True,
+                         lambda p, r: dropped.append(p))
+        pool.set_peer_height("only", 5)
+        pool.make_next_requests()
+        for _ in range(bpool.MAX_STRIKES + 2):
+            for req in pool.requests.values():
+                req.peer_id = "only"
+                req.sent_at = t[0]
+            t[0] += bpool.REQUEST_TIMEOUT_S + bpool.BACKOFF_CAP_S + 1
+            pool.retry_stale_requests()
+        # struck out many times over, but it is the only peer we have:
+        # throttled (backoff), never evicted — a slow sync beats none
+        assert dropped == []
+        assert pool.num_peers() == 1
+    finally:
+        clock.set_source(None)
+
+
+def test_reactor_tracks_peer_heights_for_prune_floor():
+    key = PrivKey.generate(b"\x09" * 32)
+    gen = GenesisDoc(chain_id="ph-test", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    state, exec_, store = fresh_node(gen)
+    r = BlockchainReactor(state, exec_, store, fast_sync=False)
+    assert r.min_peer_height() > 1 << 60   # no peers: unconstrained
+
+    class P:
+        id = "peer1"
+
+        @staticmethod
+        def try_send_obj(ch, obj):
+            return True
+
+    r.receive(0x40, P, __import__(
+        "tendermint_tpu.types.encoding", fromlist=["cdumps"]).cdumps(
+        {"type": "status_response", "height": 7}))
+    assert r.min_peer_height() == 7
+    r.remove_peer(P, "bye")
+    assert r.min_peer_height() > 1 << 60
+
+
 def test_pool_caught_up():
     pool = BlockPool(5, lambda p, h: True, lambda p, r: None)
     pool.set_peer_height("peerA", 4)

@@ -1,0 +1,444 @@
+"""Recovery-plane tests: chunked snapshot store (atomic publish,
+content-addressed chunks, Merkle manifest), store pruning boundaries
+(snapshot / evidence / peer floors), handshake app-recovery from a
+pruned store, and the crash-at-every-recovery-fail-point sweep against
+a clean control's AppHash (the in-process analogue of the commit-point
+sweep in test_fail_points.py)."""
+
+import hashlib
+import os
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.consensus import MockTicker
+from tendermint_tpu.node import Node
+from tendermint_tpu.storage import (
+    BlockStore, MemDB, SnapshotManager, SnapshotStore, SQLiteDB,
+    StateStore,
+)
+from tendermint_tpu.storage.snapshot import (
+    MANIFEST_NAME, build_payload, chunk_name, light_verify_payload,
+    manifest_root,
+)
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import PrivValidatorFile
+from tendermint_tpu.utils import fail
+
+
+class _Crash(BaseException):
+    """Simulated process death at a fail point (BaseException: nothing
+    between the fail point and the test may swallow it)."""
+
+
+def _payload(n_app=5):
+    return {"state": {"chain_id": "t", "app_hash": "ab" * 32},
+            "commit": {}, "app": [["%02x" % i, "aa"] for i in range(n_app)]}
+
+
+# ------------------------------------------------------- snapshot store --
+
+def test_take_assemble_roundtrip_and_idempotence(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    m = store.take(8, _payload(), chunk_size=16)
+    assert len(m["chunks"]) > 1
+    assert m["root"] == manifest_root(m["chunks"])
+    assert store.list_heights() == [8]
+    assert store.assemble_payload(8, m["root"]) == _payload()
+    # idempotent: a second take returns the SAME manifest untouched
+    assert store.take(8, _payload(99), chunk_size=16) == m
+
+
+def test_chunks_are_content_addressed_and_digest_checked(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    m = store.take(4, _payload(), chunk_size=16)
+    digest = m["chunks"][1]
+    data = store.read_chunk(4, 1)
+    assert hashlib.sha256(data).hexdigest() == digest
+    # bit-rot: a corrupted chunk file is refused, and assembly fails
+    path = os.path.join(store.dir_for(4), chunk_name(digest))
+    with open(path, "wb") as f:
+        f.write(b"\x00" * len(data))
+    assert store.read_chunk(4, 1) is None
+    with pytest.raises(ValueError, match="missing or corrupt"):
+        store.assemble_payload(4)
+
+
+def test_tampered_manifest_root_rejected(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    m = store.take(4, _payload(), chunk_size=64)
+    m["root"] = "00" * 32
+    import tendermint_tpu.types.encoding as encoding
+    with open(os.path.join(store.dir_for(4), MANIFEST_NAME), "wb") as f:
+        f.write(encoding.cdumps(m))
+    with pytest.raises(ValueError, match="root mismatch"):
+        store.assemble_payload(4)
+
+
+def test_crash_mid_write_never_publishes_half_snapshot(tmp_path):
+    """A crash at snapshot.after_chunk or snapshot.before_publish
+    leaves NO visible snapshot — only a temp dir the next take sweeps."""
+    for point in ("snapshot.after_chunk", "snapshot.before_publish"):
+        store = SnapshotStore(str(tmp_path / point.replace(".", "_")))
+
+        def crash(name):
+            raise _Crash(name)
+
+        fail.arm(point, crash)
+        with pytest.raises(_Crash):
+            store.take(8, _payload(), chunk_size=16)
+        fail.disarm_all()
+        assert store.list_heights() == []
+        assert store.load_manifest(8) is None
+        # recovery: the next take republishes cleanly and sweeps tmp
+        m = store.take(8, _payload(), chunk_size=16)
+        assert store.assemble_payload(8, m["root"]) == _payload()
+        leftover = [n for n in os.listdir(store.root_dir)
+                    if n.startswith(".tmp-")]
+        assert leftover == []
+
+
+def test_retention_drops_oldest(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    for h in (2, 4, 6, 8):
+        store.take(h, _payload(), chunk_size=64)
+    assert store.retain(2) == [2, 4]
+    assert store.list_heights() == [6, 8]
+
+
+# ----------------------------------------------------------- db pruning --
+
+@pytest.mark.parametrize("mk", [lambda tmp: MemDB(),
+                                lambda tmp: SQLiteDB(str(tmp / "kv.db"))])
+def test_delete_batch_and_compact(tmp_path, mk):
+    db = mk(tmp_path)
+    db.set_batch([(b"k%03d" % i, b"v" * 64) for i in range(100)])
+    db.delete_batch([b"k%03d" % i for i in range(50)])
+    assert db.get(b"k000") is None and db.get(b"k099") is not None
+    assert len(list(db.iterate(b"k"))) == 50
+    db.compact()  # must be callable at any quiescent point
+    assert len(list(db.iterate(b"k"))) == 50
+    db.close()
+
+
+def test_block_store_prune_and_base(tmp_path):
+    from tests.test_fast_sync import build_chain
+    key = PrivKey.generate(b"\x09" * 32)
+    gen = GenesisDoc(chain_id="prune-bs", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    _, _, store, gen = build_chain(gen, key, 8)
+    assert store.base() == 1
+    n = store.prune(5, window=2)
+    assert n == 4
+    assert store.base() == 5
+    assert store.load_block(4) is None
+    assert store.load_block_meta(4) is None
+    assert store.load_block(5) is not None
+    assert store.load_seen_commit(4) is None
+    # pruning is capped at the frontier and never re-deletes
+    assert store.prune(100) == store.height() - 5
+    assert store.base() == store.height()
+
+
+def test_block_store_prune_crash_mid_range_is_idempotent(tmp_path):
+    from tests.test_fast_sync import build_chain
+    key = PrivKey.generate(b"\x09" * 32)
+    gen = GenesisDoc(chain_id="prune-crash", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    _, _, store, gen = build_chain(gen, key, 8)
+
+    hits = []
+
+    def crash(name):
+        hits.append(name)
+        if len(hits) == 1:  # die after the FIRST window's deletes
+            raise _Crash(name)
+
+    fail.arm("prune.mid_range", crash)
+    with pytest.raises(_Crash):
+        store.prune(7, window=2)
+    fail.disarm_all()
+    # the first window died before its base advance (rows 1-2 deleted,
+    # row says 1): base() self-heals by scanning to the first retained
+    # block, so a restarted handshake never asks for a deleted height
+    assert store.base() == 3
+    assert store.prune(7, window=2) == 4
+    assert store.base() == 7
+    assert store.load_block(7) is not None
+
+
+def test_state_store_prune_keeps_indirection_targets():
+    ss = StateStore(MemDB())
+    k = PrivKey.generate(b"\x01" * 32)
+    gen = GenesisDoc(chain_id="ssp", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)])
+    state = ss.load_or_genesis(gen)
+    # heights 1..9 with no valset change: every row points at 1
+    for h in range(1, 10):
+        state = state.copy()
+        state.last_block_height = h
+        ss.save(state)
+        ss.save_abci_responses(h, {"deliver_txs": [], "end_block": {}})
+    ss.prune(7)
+    # rows below 7 swept, EXCEPT the pointer target (height 1)
+    assert ss.load_abci_responses(3) is None
+    vs = ss.load_validators(8)   # 8 -> last_changed 1 must still resolve
+    assert vs.hash() == state.validators.hash()
+    assert ss.load_consensus_params(9) is not None
+
+
+def test_state_store_bootstrap_and_pins():
+    ss = StateStore(MemDB())
+    k = PrivKey.generate(b"\x02" * 32)
+    gen = GenesisDoc(chain_id="ssb", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)])
+    state = ss.load_or_genesis(gen)
+    state = state.copy()
+    state.last_block_height = 42
+    state.last_validators = state.validators
+    ss.bootstrap(state)
+    assert ss.load().last_block_height == 42
+    assert ss.load_validators(42).hash() == state.validators.hash()
+    assert ss.load_validators(43).hash() == state.validators.hash()
+    ss.pin_snapshot(42, {"root": "ab" * 32})
+    assert ss.latest_snapshot_height() == 42
+    assert ss.load_snapshot_pin(42)["root"] == "ab" * 32
+    ss.unpin_snapshot(42)
+    assert ss.load_snapshot_pin(42) is None
+
+
+# -------------------------------------------------- prune floor policy --
+
+class _FloorHarness:
+    """SnapshotManager over real Mem stores with a scripted chain."""
+
+    def __init__(self, tmp_path, retain, interval=2, max_age=100000,
+                 peer_floor=None):
+        from tests.test_fast_sync import build_chain
+        key = PrivKey.generate(b"\x09" * 32)
+        gen = GenesisDoc(
+            chain_id="floor", genesis_time_ns=1,
+            validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+        gen.consensus_params.evidence.max_age = max_age
+        self.state, self.state_store, self.block_store, _ = \
+            build_chain(gen, key, 10)
+        self.app = KVStoreApp()
+        self.mgr = SnapshotManager(
+            SnapshotStore(str(tmp_path)), self.state_store,
+            self.block_store, self.app, interval=interval,
+            retain_heights=retain, peer_floor=peer_floor)
+
+
+def test_prune_refuses_below_latest_snapshot(tmp_path):
+    h = _FloorHarness(tmp_path, retain=1, interval=0)
+    # retain=1 would prune to height 10 — but with NO snapshot at all
+    # pruning must refuse entirely (the app could never rebuild)
+    h.mgr.maybe_snapshot(h.state)
+    assert h.block_store.base() == 1
+    # with a snapshot pinned at 6, the floor is capped AT it
+    m = h.mgr.store.take(6, _payload())
+    h.state_store.pin_snapshot(6, m)
+    h.mgr.maybe_snapshot(h.state)
+    assert h.block_store.base() == 6
+    assert h.block_store.load_block(6) is not None
+
+
+def test_prune_respects_peer_catchup_frontier(tmp_path):
+    h = _FloorHarness(tmp_path, retain=1, interval=2,
+                      peer_floor=lambda: 4)
+    h.mgr.maybe_snapshot(h.state)  # snapshots at 10, floor min(10, 4)=4
+    assert h.block_store.base() == 4
+    assert h.block_store.load_block(4) is not None
+
+
+def test_prune_respects_evidence_horizon_in_state_store(tmp_path):
+    h = _FloorHarness(tmp_path, retain=1, interval=2, max_age=3)
+    h.mgr.maybe_snapshot(h.state)
+    # blocks pruned to the snapshot floor (10)...
+    assert h.block_store.base() == 10
+    # ...but state rows within the evidence window (10-3+1 = 8) survive
+    assert h.state_store.load_validators(8) is not None
+    assert h.state_store.load_abci_responses(7) is None
+
+
+# ------------------------------------- node-level sweep vs control run --
+
+WAVE_A = [b"sn/a%d=v%d" % (i, i) for i in range(1, 4)]
+WAVE_B = [b"sn/b%d=w%d" % (i, i) for i in range(1, 4)]
+
+RECOVERY_SWEEP_POINTS = ("snapshot.after_chunk",
+                         "snapshot.before_publish",
+                         "prune.mid_range")
+
+
+def _gen(chain_id):
+    key = PrivKey.generate(b"\x0b" * 32)
+    gen = GenesisDoc(chain_id=chain_id, genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    gen.consensus_params.evidence.max_age = 4
+    return gen, key
+
+
+def _make_node(home, gen, key):
+    pv_path = os.path.join(home, "priv_validator.json")
+    if os.path.exists(pv_path):
+        pv = PrivValidatorFile.load(pv_path)
+    else:
+        pv = PrivValidatorFile(pv_path, key)
+        pv._persist()
+    node = Node(make_test_config(home), gen, priv_validator=pv,
+                app=KVStoreApp())
+    node.consensus.ticker.stop()
+    node.consensus.ticker = MockTicker(node.consensus._on_timeout_fire)
+    return node
+
+
+def _inject(node, txs):
+    for tx in txs:
+        try:
+            node.mempool.check_tx(tx)
+        except Exception:
+            pass
+
+
+def _commit_to(node, target_height, max_ticks=400):
+    for _ in range(max_ticks):
+        if node.height >= target_height:
+            return
+        node.consensus.ticker.fire_next()
+    raise AssertionError(f"stuck at height {node.height}")
+
+
+def _drain(node, max_ticks=200):
+    for _ in range(max_ticks):
+        if node.mempool.size() == 0:
+            return
+        node.consensus.ticker.fire_next()
+    raise AssertionError("mempool never drained")
+
+
+def test_crash_at_every_recovery_point_recovers_control_apphash(
+        tmp_path, monkeypatch):
+    """For EVERY snapshot/prune fail point: run a snapshotting+pruning
+    node, crash it at that point mid-run, rebuild from the home dir,
+    and require the recovered node to reach the control run's height
+    with the IDENTICAL AppHash — and with no half-published snapshot
+    visible. The control runs with the whole recovery plane OFF, so
+    the sweep also pins snapshot/prune heights as behavior-neutral."""
+    target = 6
+    gen, key = _gen("snap-sweep")
+
+    control = _make_node(str(tmp_path / "control"), gen, key)
+    control.start()
+    _inject(control, WAVE_A)
+    _commit_to(control, 3)
+    _inject(control, WAVE_B)
+    _commit_to(control, target)
+    _drain(control)
+    control_hash = control.consensus.state.app_hash
+    control.stop()
+    assert control_hash
+
+    monkeypatch.setenv("TM_TPU_SNAPSHOT_INTERVAL", "2")
+    monkeypatch.setenv("TM_TPU_SNAPSHOT_KEEP", "2")
+    monkeypatch.setenv("TM_TPU_RETAIN_HEIGHTS", "2")
+    for point in RECOVERY_SWEEP_POINTS:
+        home = str(tmp_path / point.replace(".", "_"))
+        node = _make_node(home, gen, key)
+        node.start()
+        _inject(node, WAVE_A)
+        _commit_to(node, 3)
+
+        def crash(name):
+            raise _Crash(name)
+
+        fail.arm(point, crash)
+        with pytest.raises(_Crash):
+            _inject(node, WAVE_B)
+            _commit_to(node, target)
+            raise AssertionError(f"{point} never fired")
+        fail.disarm_all()
+        crashed_at = node.height
+        node.consensus._stopped = True
+        try:
+            node.stop()
+        except Exception:
+            pass
+
+        node2 = _make_node(home, gen, key)
+        node2.start()
+        assert node2.height >= crashed_at   # no committed height lost
+        _inject(node2, WAVE_B)
+        _commit_to(node2, target)
+        _drain(node2)
+        assert node2.consensus.state.app_hash == control_hash, (
+            f"{point}: recovered AppHash diverged")
+        # no half-published snapshot anywhere: every listed height has
+        # a verifiable manifest + chunks
+        for h in node2.snapshot_store.list_heights():
+            node2.snapshot_store.assemble_payload(h)
+        assert not [n for n in os.listdir(node2.snapshot_store.root_dir)
+                    if n.startswith(".tmp-")]
+        node2.stop()
+
+
+def test_pruned_store_restart_recovers_app_from_snapshot(tmp_path,
+                                                         monkeypatch):
+    """After pruning, a restart can no longer replay the app from
+    genesis — the handshake must rebuild it from the newest pinned
+    snapshot plus the retained tail blocks, bit-identically."""
+    monkeypatch.setenv("TM_TPU_SNAPSHOT_INTERVAL", "3")
+    monkeypatch.setenv("TM_TPU_RETAIN_HEIGHTS", "2")
+    gen, key = _gen("snap-restart")
+    home = str(tmp_path)
+    node = _make_node(home, gen, key)
+    node.start()
+    for w in range(8):
+        _inject(node, [b"pr/k%d=v%d" % (w, w)])
+        _commit_to(node, w + 1)
+    _drain(node)
+    app_hash = node.consensus.state.app_hash
+    height = node.height
+    assert node.block_store.base() > 1          # pruning really ran
+    assert node.snapshot_store.list_heights()   # snapshots exist
+    node.stop()
+
+    node2 = _make_node(home, gen, key)
+    assert node2.consensus.state.last_block_height == height
+    assert node2.consensus.state.app_hash == app_hash
+    assert node2.app.height == height
+    # and the revived node keeps committing
+    node2.start()
+    _inject(node2, [b"pr/after=1"])
+    _commit_to(node2, height + 1)
+    node2.stop()
+
+
+def test_light_verify_payload_rejects_forged_commit():
+    """A snapshot whose commit does not carry +2/3 genuine signatures
+    for the claimed block id must be rejected."""
+    from tests.test_fast_sync import build_chain
+    key = PrivKey.generate(b"\x09" * 32)
+    gen = GenesisDoc(chain_id="lv", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    state, _, store, gen = build_chain(gen, key, 4)
+    commit = store.load_seen_commit(state.last_block_height)
+    payload = build_payload(state, commit,
+                            [(b"k", b"v")])
+    st, cm = light_verify_payload(payload, "lv")   # genuine: passes
+    assert st.last_block_height == state.last_block_height
+
+    forged = build_payload(state, commit, [(b"k", b"v")])
+    forged["commit"] = dict(forged["commit"])
+    pcs = [dict(p) if p else None
+           for p in forged["commit"]["precommits"]]
+    for p in pcs:
+        if p is not None:
+            p["signature"] = "00" * 64
+    forged["commit"]["precommits"] = pcs
+    with pytest.raises(ValueError):
+        light_verify_payload(forged, "lv")
+    # wrong chain id is refused before any crypto
+    with pytest.raises(ValueError, match="chain_id"):
+        light_verify_payload(payload, "other-chain")

@@ -1,0 +1,417 @@
+"""State-sync reactor tests: a fresh node joining over real in-process
+switches via snapshot restore (then fast-syncing the tail), the
+adversarial chunk plane (corrupted chunk -> ban + re-fetch elsewhere,
+forged manifest, snapshot failing light verification -> poisoned +
+fallback), and crash-resume of a torn restore."""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.proxy import AppConns, local_client_creator
+from tendermint_tpu.abci.types import ValidatorUpdate
+from tendermint_tpu.blockchain import BlockchainReactor
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.consensus import ConsensusState, MockTicker
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.p2p.test_util import connect_switches, make_switch
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.statesync import (
+    STATESYNC_CHANNEL, StateSyncReactor, resume_pending_restore,
+)
+from tendermint_tpu.storage import (
+    BlockStore, MemDB, SnapshotManager, SnapshotStore, StateStore,
+)
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
+from tendermint_tpu.utils import fail
+
+
+class _Crash(BaseException):
+    pass
+
+
+def _build_source(tmp_path, n_blocks=14, interval=4, chunk_size=256):
+    """Single-validator chain with interval snapshots; returns a dict
+    of everything the serving side needs."""
+    key = PrivKey.generate(b"\x09" * 32)
+    gen = GenesisDoc(chain_id="ss-net", genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519, 10)])
+    app = KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_store.load_or_genesis(gen)
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], gen.chain_id)
+    exec_ = BlockExecutor(state_store, conns.consensus)
+    snap_store = SnapshotStore(str(tmp_path / "src-snapshots"))
+    mgr = SnapshotManager(snap_store, state_store, block_store, app,
+                          interval=interval, keep=2,
+                          chunk_size=chunk_size)
+    cs = ConsensusState(
+        make_test_config().consensus, state, exec_, block_store,
+        priv_validator=PrivValidator(LocalSigner(key)),
+        ticker_factory=MockTicker)
+    cs.post_commit_hooks.append(mgr.maybe_snapshot)
+    cs.start()
+    wave = 0
+    for _ in range(120 * n_blocks):
+        if cs.state.last_block_height >= n_blocks:
+            break
+        if cs.state.last_block_height >= wave:
+            wave += 1
+            try:
+                cs.mempool.check_tx(b"ss/k%d=v%d" % (wave, wave))
+            except Exception:
+                pass
+        cs.ticker.fire_next()
+    assert cs.state.last_block_height >= n_blocks
+    assert snap_store.list_heights(), "source produced no snapshots"
+    return {"gen": gen, "cs": cs, "app": app, "block_store": block_store,
+            "state_store": state_store, "snap_store": snap_store}
+
+
+def _serving_switch(src, seed, reactor_cls=StateSyncReactor,
+                    snap_store=None):
+    ss = reactor_cls(snap_store or src["snap_store"], "ss-net")
+    bc = BlockchainReactor(src["cs"].state, None, src["block_store"],
+                           fast_sync=False)
+    sw = make_switch(network="ss-net", seed=seed)
+    sw.add_reactor("blockchain", bc)
+    sw.add_reactor("statesync", ss)
+    sw.start()
+    return sw
+
+
+def _fresh_side(tmp_path, gen, name="new", give_up_s=8.0):
+    """Restoring-node assembly; returns components + its switch."""
+    app = KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_store.load_or_genesis(gen)
+    conns.consensus.init_chain(
+        [ValidatorUpdate(v.pubkey, v.voting_power)
+         for v in state.validators.validators], gen.chain_id)
+    exec_ = BlockExecutor(state_store, conns.consensus)
+    gate = threading.Event()
+    cs = ConsensusState(make_test_config().consensus, state, exec_,
+                        block_store, priv_validator=None,
+                        ticker_factory=MockTicker)
+    cons = ConsensusReactor(cs, fast_sync=True)
+    bc = BlockchainReactor(state, exec_, block_store, fast_sync=True,
+                           consensus_reactor=cons, verify_window=5,
+                           gate=gate)
+    local_snaps = SnapshotStore(str(tmp_path / f"{name}-snapshots"))
+    statesync_dir = str(tmp_path / f"{name}-statesync")
+
+    def on_done(restored, _cs=cs, _bc=bc, _gate=gate):
+        if restored is not None:
+            _cs.state = restored
+            _bc.adopt_restored(restored)
+        _gate.set()
+
+    ss = StateSyncReactor(local_snaps, "ss-net", restore=True,
+                          statesync_dir=statesync_dir,
+                          block_store=block_store,
+                          state_store=state_store, app=app,
+                          on_restored=on_done, give_up_s=give_up_s)
+    sw = make_switch(network="ss-net", seed=b"\x7f" * 32)
+    sw.add_reactor("consensus", cons)
+    sw.add_reactor("blockchain", bc)
+    sw.add_reactor("statesync", ss)
+    return {"app": app, "block_store": block_store,
+            "state_store": state_store, "bc": bc, "ss": ss, "sw": sw,
+            "gate": gate, "statesync_dir": statesync_dir,
+            "local_snaps": local_snaps}
+
+
+def _wait(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(what)
+
+
+def test_fresh_node_joins_via_snapshot_then_fast_syncs_tail(tmp_path):
+    src = _build_source(tmp_path)
+    sw_src = _serving_switch(src, b"\x01" * 32)
+    new = _fresh_side(tmp_path, src["gen"])
+    new["sw"].start()
+    connect_switches(sw_src, new["sw"])
+    try:
+        _wait(lambda: new["bc"].synced, 40, "never synced")
+        restored = new["ss"].restored_state
+        assert restored is not None
+        snap_h = restored.last_block_height
+        assert snap_h == max(src["snap_store"].list_heights())
+        # the restore bootstrapped the stores AT the snapshot height:
+        # no block below it was ever fetched or stored
+        assert new["block_store"].base() == snap_h + 1
+        # ...and fast-sync carried the node to the frontier
+        assert new["block_store"].height() >= \
+            src["block_store"].height() - 1
+        top = new["block_store"].height()
+        meta_src = src["block_store"].load_block_meta(top)
+        meta_new = new["block_store"].load_block_meta(top)
+        assert meta_src.block_id.key() == meta_new.block_id.key()
+        # the app really followed: replayed tail on top of the restore
+        assert new["app"].height == top
+    finally:
+        sw_src.stop()
+        new["sw"].stop()
+
+
+def test_corrupted_chunk_bans_peer_and_refetches_elsewhere(tmp_path):
+    """One of two serving peers corrupts every chunk it serves: the
+    restorer must ban it on the first bad digest and complete the
+    restore from the honest peer."""
+    src = _build_source(tmp_path, chunk_size=64)  # many chunks
+
+    class EvilChunks(StateSyncReactor):
+        served = 0
+
+        def _serve_chunk(self, peer, msg):
+            m = self.snapshot_store.load_manifest(
+                int(msg.get("height", 0)))
+            if m is None:
+                return super()._serve_chunk(peer, msg)
+            EvilChunks.served += 1
+            peer.try_send_obj(STATESYNC_CHANNEL, {
+                "type": "chunk_response", "height": m["height"],
+                "index": int(msg.get("index", 0)),
+                "root": msg.get("root", ""),
+                "data": (b"\xde\xad" * 40).hex()})
+
+    sw_honest = _serving_switch(src, b"\x01" * 32)
+    sw_evil = _serving_switch(src, b"\x02" * 32,
+                              reactor_cls=EvilChunks)
+    new = _fresh_side(tmp_path, src["gen"])
+    new["sw"].start()
+    connect_switches(sw_evil, new["sw"])
+    connect_switches(sw_honest, new["sw"])
+    try:
+        _wait(lambda: new["ss"].finished.is_set(), 40,
+              "restore never concluded")
+        assert new["ss"].restored_state is not None
+        evil_id = sw_evil.node_info.id
+        assert evil_id in new["ss"]._banned
+        assert EvilChunks.served >= 1      # it really served bad data
+        _wait(lambda: new["bc"].synced, 30, "tail sync never finished")
+        assert new["bc"].state.app_hash == src["cs"].state.app_hash or \
+            new["block_store"].height() >= \
+            src["block_store"].height() - 1
+    finally:
+        sw_honest.stop()
+        sw_evil.stop()
+        new["sw"].stop()
+
+
+def test_forged_manifest_rejected_and_peer_banned(tmp_path):
+    """A manifest whose chunk list does not hash to the advertised
+    root is refused before a single chunk is requested."""
+    src = _build_source(tmp_path)
+
+    class EvilManifest(StateSyncReactor):
+        def _serve_manifest(self, peer, msg):
+            m = self.snapshot_store.load_manifest(
+                int(msg.get("height", 0)))
+            if m is None:
+                return super()._serve_manifest(peer, msg)
+            m = dict(m)
+            m["chunks"] = ["00" * 32] * len(m["chunks"])  # truncate/forge
+            peer.try_send_obj(STATESYNC_CHANNEL, {
+                "type": "manifest_response", "height": m["height"],
+                "manifest": m})
+
+    sw_evil = _serving_switch(src, b"\x02" * 32,
+                              reactor_cls=EvilManifest)
+    new = _fresh_side(tmp_path, src["gen"], give_up_s=6.0)
+    new["sw"].start()
+    connect_switches(sw_evil, new["sw"])
+    try:
+        _wait(lambda: new["ss"].finished.is_set(), 40,
+              "restore never concluded")
+        # only peer lied -> no restore; node falls back to block sync
+        assert new["ss"].restored_state is None
+        assert sw_evil.node_info.id in new["ss"]._banned
+        assert new["gate"].is_set()
+    finally:
+        sw_evil.stop()
+        new["sw"].stop()
+
+
+def test_snapshot_failing_light_verification_aborts_restore(tmp_path):
+    """A snapshot whose payload carries a forged commit passes every
+    chunk digest (the peer built it honestly from bad data) but fails
+    the light verification at apply time: the restore is aborted, the
+    snapshot poisoned, and the node falls back to block replay."""
+    src = _build_source(tmp_path, n_blocks=8, interval=4)
+    from tendermint_tpu.storage.snapshot import build_payload
+    # rebuild the latest snapshot from a payload with zeroed signatures
+    h = max(src["snap_store"].list_heights())
+    payload = src["snap_store"].assemble_payload(h)
+    for p in payload["commit"]["precommits"]:
+        if p is not None:
+            p["signature"] = "00" * 64
+    evil_store = SnapshotStore(str(tmp_path / "evil-snapshots"))
+    evil_store.take(h, payload, chunk_size=256)
+
+    sw_evil = _serving_switch(src, b"\x02" * 32, snap_store=evil_store)
+    new = _fresh_side(tmp_path, src["gen"], give_up_s=6.0)
+    new["sw"].start()
+    connect_switches(sw_evil, new["sw"])
+    try:
+        _wait(lambda: new["ss"].finished.is_set(), 40,
+              "restore never concluded")
+        assert new["ss"].restored_state is None
+        # the poisoned snapshot key is remembered
+        assert any(k[0] == h for k in new["ss"]._poisoned)
+        # stores untouched: fallback starts from genesis
+        assert new["block_store"].height() == 0
+        _wait(lambda: new["bc"].synced, 40, "fallback sync never ran")
+        assert new["block_store"].height() >= \
+            src["block_store"].height() - 1
+    finally:
+        sw_evil.stop()
+        new["sw"].stop()
+
+
+def test_crash_mid_restore_resumes_from_disk(tmp_path):
+    """Kill the restore at statesync.before_apply (all chunks on disk,
+    stores untouched) and at statesync.after_restore (stores
+    bootstrapped, dir not yet adopted): in both cases a restart's
+    resume_pending_restore completes the restore idempotently."""
+    src = _build_source(tmp_path, n_blocks=8, interval=4)
+    h = max(src["snap_store"].list_heights())
+    manifest = src["snap_store"].load_manifest(h)
+
+    for point in ("statesync.before_apply", "statesync.after_restore"):
+        tag = point.replace(".", "_")
+        # simulate the fetch phase having completed: the restore dir
+        # holds the manifest + every chunk (content-addressed files)
+        statesync_dir = str(tmp_path / f"{tag}-statesync")
+        restore_store = SnapshotStore(statesync_dir)
+        os.makedirs(restore_store.dir_for(h))
+        import shutil
+        for name in os.listdir(src["snap_store"].dir_for(h)):
+            shutil.copy(os.path.join(src["snap_store"].dir_for(h), name),
+                        os.path.join(restore_store.dir_for(h), name))
+        app = KVStoreApp()
+        state_store = StateStore(MemDB())
+        block_store = BlockStore(MemDB())
+        state_store.load_or_genesis(src["gen"])
+        local_snaps = SnapshotStore(str(tmp_path / f"{tag}-snapshots"))
+
+        def crash(name):
+            raise _Crash(name)
+
+        fail.arm(point, crash)
+        from tendermint_tpu.statesync.reactor import apply_restore
+        with pytest.raises(_Crash):
+            apply_restore(restore_store, manifest, block_store,
+                          state_store, local_snaps, app, "ss-net")
+        fail.disarm_all()
+        # the restore dir is still there (not adopted): resumable
+        assert restore_store.load_manifest(h) is not None
+
+        # "restart": a fresh app + the same disk; resume must finish
+        app2 = KVStoreApp()
+        state = resume_pending_restore(
+            statesync_dir, block_store, state_store, local_snaps, app2,
+            "ss-net")
+        assert state is not None
+        assert state.last_block_height == h
+        assert block_store.height() == h
+        assert block_store.base() == h + 1
+        assert state_store.load().last_block_height == h
+        assert state_store.latest_snapshot_height() == h
+        assert app2.height == h
+        assert app2.app_hash == state.app_hash
+        # adopted: restore dir gone, snapshot in the local library
+        assert restore_store.list_heights() == []
+        assert local_snaps.list_heights() == [h]
+        # nothing pending anymore
+        assert resume_pending_restore(
+            statesync_dir, block_store, state_store, local_snaps,
+            KVStoreApp(), "ss-net") is None
+
+
+def test_restore_resumes_partial_chunk_dir(tmp_path):
+    """A restore dir already holding SOME verified chunks (a previous
+    crash mid-download) only fetches the remainder."""
+    src = _build_source(tmp_path, chunk_size=64)
+    h = max(src["snap_store"].list_heights())
+    manifest = src["snap_store"].load_manifest(h)
+    assert len(manifest["chunks"]) >= 3
+
+    new = _fresh_side(tmp_path, src["gen"])
+    # pre-seed the restore dir with manifest + half the chunks, plus
+    # one TORN chunk file that must be re-fetched, not trusted
+    restore_store = SnapshotStore(new["statesync_dir"])
+    os.makedirs(restore_store.dir_for(h))
+    src_dir = src["snap_store"].dir_for(h)
+    import shutil
+    shutil.copy(os.path.join(src_dir, "manifest.json"),
+                os.path.join(restore_store.dir_for(h), "manifest.json"))
+    from tendermint_tpu.storage.snapshot import chunk_name
+    half = manifest["chunks"][:len(manifest["chunks"]) // 2]
+    for digest in half:
+        shutil.copy(os.path.join(src_dir, chunk_name(digest)),
+                    os.path.join(restore_store.dir_for(h),
+                                 chunk_name(digest)))
+    torn = manifest["chunks"][-1]
+    with open(os.path.join(restore_store.dir_for(h),
+                           chunk_name(torn)), "wb") as f:
+        f.write(b"torn")
+
+    sw_src = _serving_switch(src, b"\x01" * 32)
+    new["sw"].start()
+    connect_switches(sw_src, new["sw"])
+    try:
+        _wait(lambda: new["ss"].finished.is_set(), 40,
+              "restore never concluded")
+        assert new["ss"].restored_state is not None
+        assert new["ss"].restored_state.last_block_height == h
+    finally:
+        sw_src.stop()
+        new["sw"].stop()
+
+
+# --------------------------------------------------- chaos acceptance --
+
+@pytest.mark.slow
+def test_chaos_with_snapshot_plane_and_crashes_stays_clean(
+        tmp_path, monkeypatch):
+    """ChaosNet soak with the whole recovery plane ON (interval
+    snapshots + pruning on every node) and a crash armed at a snapshot
+    fail point mid-run: every invariant check must stay clean and the
+    net must keep committing through the crash-restart."""
+    monkeypatch.setenv("TM_TPU_SNAPSHOT_INTERVAL", "2")
+    monkeypatch.setenv("TM_TPU_SNAPSHOT_KEEP", "2")
+    monkeypatch.setenv("TM_TPU_RETAIN_HEIGHTS", "4")
+    from tendermint_tpu.chaos.runner import run_chaos
+    for point in ("snapshot.before_publish", "snapshot.after_chunk",
+                  "prune.mid_range"):
+        spec = {
+            "drop": 0.02,
+            "delay": 0.05,
+            "delay_steps": [1, 2],
+            "stall_assist": True,
+            "crashes": [{"node": 2, "after_height": 2, "point": point,
+                         "down_steps": 12}],
+        }
+        report = run_chaos(
+            spec=spec, seed=7,
+            workdir=str(tmp_path / point.replace(".", "_")),
+            target_height=8, max_steps=500)
+        assert report["violations"] == [], (point, report["violations"])
+        assert report["faults_injected"].get("crash", 0) >= 1, point
