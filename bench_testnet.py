@@ -279,7 +279,8 @@ def _scrape_chaos_metrics(client) -> dict:
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                duration_s: float = 25.0, burst: str = "",
                chaos: str = "", pipeline: str = "",
-               parity: bool = False, trace: str = "") -> dict:
+               parity: bool = False, trace: str = "",
+               profile: str = "") -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -313,6 +314,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
     if trace:  # causal tracing plane for every node (bench.py
         #       --trace-json); "" inherits whatever the caller exported
         env["TM_TPU_TRACE"] = trace
+    if profile:  # sampling profiler A/B for every node (bench.py
+        #         --profile-json); "" inherits the caller env
+        env["TM_TPU_PROF"] = profile
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -463,6 +467,18 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 except (OSError, RPCClientError) as e:
                     print(f"[bench] timeline fetch failed: {e!r}",
                           file=sys.stderr)
+        profiles = []
+        if profile and profile.lower() not in ("off", "0", "false"):
+            # every node's sampling-profiler table BEFORE teardown:
+            # collapsed stacks + per-subsystem busy/wait sample counts
+            # (bench.py merges them into the cluster profile)
+            for c in clients:
+                try:
+                    profiles.append(c.call("debug_profile",
+                                           action="dump"))
+                except (OSError, RPCClientError) as e:
+                    print(f"[bench] profile fetch failed: {e!r}",
+                          file=sys.stderr)
         parity_report = {}
         if parity:
             # bit-identity audit BEFORE teardown: serial replay of the
@@ -502,6 +518,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             **({"chaos": chaos, "chaos_faults": chaos_metrics}
                if chaos_metrics else {}),
             **({"timelines": timelines} if timelines else {}),
+            **({"profiles": profiles} if profiles else {}),
         }
     except BaseException:
         # keep the net tree and surface log tails: the node logs are

@@ -30,7 +30,7 @@ KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
-    "snapshot", "sync", "prune",
+    "snapshot", "sync", "prune", "prof", "queue",
 }
 
 INSTRUMENTED_MODULES = [
@@ -52,6 +52,8 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.telemetry.trace",    # tm_trace_events_dropped_total
     "tendermint_tpu.storage.snapshot",   # tm_snapshot_* / tm_prune_*
     "tendermint_tpu.statesync.reactor",  # tm_sync_* chunk/restore plane
+    "tendermint_tpu.telemetry.profile",  # tm_prof_* sampling profiler
+    "tendermint_tpu.telemetry.queues",   # tm_queue_* backpressure plane
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

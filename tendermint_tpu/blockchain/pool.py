@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu import telemetry
 from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+from tendermint_tpu.telemetry import queues as queue_obs
 from tendermint_tpu.utils import clock
 
 # Fast-sync window health: how many completed blocks sit buffered ahead
@@ -143,6 +144,14 @@ class BlockPool:
         self.requests: Dict[int, _Request] = {}
         self._started_at = time.monotonic()
         self._n_filled = 0  # requests holding a completed block (gauge)
+        # queue observatory: the in-flight request window — saturated
+        # means the apply side is the fast-sync bottleneck, empty
+        # means the network is (the tm_fastsync_window_fill twin, but
+        # on the shared saturation surface)
+        self._queue_probe = queue_obs.register(
+            "fastsync.requests", self,
+            depth=lambda p: len(p.requests),
+            capacity=MAX_PENDING_REQUESTS)
 
     # ----------------------------------------------------------------- peers
 

@@ -81,6 +81,15 @@ class BaseConfig:
     snapshot_chunk_kb: int = 256
     retain_heights: int = 0
     state_sync: bool = False
+    # runtime introspection plane (telemetry/profile.py + queues.py):
+    # `prof` on starts the sampling profiler at `prof_hz` sweeps/sec
+    # (tm_prof_* metrics, GET /debug/pprof, the debug_profile RPC);
+    # `queue_watch` (off | on | <poll seconds>) runs the bounded-queue
+    # catalog + saturation watchdog behind /healthz. TM_TPU_PROF /
+    # _PROF_HZ / _QUEUE_WATCH win over these.
+    prof: str = "off"
+    prof_hz: float = 0.0  # 0 = profile.DEFAULT_HZ (13)
+    queue_watch: str = "on"
 
 
 @dataclass

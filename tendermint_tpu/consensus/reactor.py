@@ -569,20 +569,30 @@ class ConsensusReactor(Reactor):
                                     v["validator_index"])
                 catchup_idle = 0
                 continue
-            if catchup_height:
-                # a peer STUCK behind us with nothing left to send:
-                # our marks may predate its fast-sync handoff (votes
-                # we "sent" were dropped unprocessed). After ~2s of
-                # idling, forget the height's marks and resend — the
-                # un-wedge for a rejoining node whose sync frontier
-                # landed exactly on its boot-announced height.
-                catchup_idle += 1
-                if catchup_idle * self.gossip_sleep_s >= 2.0:
-                    catchup_idle = 0
+            # nothing sendable this pass: after ~2s of consecutive
+            # idling, self-heal. Two shapes, one threshold:
+            # - catchup peer: our marks may predate its fast-sync
+            #   handoff (votes we "sent" were dropped unprocessed) —
+            #   forget the height's marks and resend (PR 9).
+            # - otherwise: re-announce our NewRoundStep. The add_peer
+            #   announcement is a try_send into a just-built conn and
+            #   the receive side drops messages arriving before its
+            #   peer state registers, so either end of the connect
+            #   race can eat it — leaving the PEER's view of us blank
+            #   at (0, -1) while our view of it looks fine. The side
+            #   with the stale view cannot know it; the side with
+            #   NOTHING TO SEND re-announcing is what breaks the
+            #   genesis wedge (both halves idle forever otherwise).
+            #   Idempotent, one ~60-byte STATE message per idle peer
+            #   per threshold.
+            catchup_idle += 1
+            if catchup_idle * self.gossip_sleep_s >= 2.0:
+                catchup_idle = 0
+                if catchup_height:
                     ps.forget_height(catchup_height)
                     continue
-            else:
-                catchup_idle = 0
+                peer.try_send_obj(STATE_CHANNEL,
+                                  self._our_round_step_msg())
             ps.wake.wait(self.gossip_sleep_s)
             ps.wake.clear()
 

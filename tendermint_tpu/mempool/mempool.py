@@ -31,6 +31,7 @@ from typing import Callable, List, Optional
 from tendermint_tpu import telemetry
 from tendermint_tpu.abci.types import ResultCheckTx
 from tendermint_tpu.mempool.clist import CList
+from tendermint_tpu.telemetry import queues as queue_obs
 
 _m_size = telemetry.gauge(
     "mempool_size", "Pending transactions in the mempool")
@@ -104,6 +105,12 @@ class Mempool:
         self.proxy_mtx = threading.RLock()  # the reference's proxyMtx
         self.notified_txs_available = False
         self.txs_available_hook: Optional[Callable[[], None]] = None
+        # queue observatory: the pending-tx queue against its admission
+        # bound — the "mempool full" backpressure the RPC front door
+        # reports one rejection at a time becomes a saturation gauge
+        self._queue_probe = queue_obs.register(
+            "mempool.txs", self, depth=lambda m: len(m.txs),
+            capacity=self.max_size)
         self._wal_file = None
         self._wal_path = None
         if wal_dir:
@@ -132,6 +139,7 @@ class Mempool:
             _m_size.set(0)
 
     def close(self) -> None:
+        self._queue_probe.close()
         if self._wal_file is not None:
             self._wal_file.close()
             self._wal_file = None

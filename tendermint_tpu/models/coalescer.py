@@ -43,6 +43,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from tendermint_tpu import telemetry
+from tendermint_tpu.telemetry import queues as queue_obs
 
 # Catalog in docs/observability.md. The coalesce FACTOR — the number the
 # tentpole is judged on — is coalesce_calls_total / dispatches_total,
@@ -175,6 +176,13 @@ class DispatchCoalescer:
         self.idle_timeout_s = 30.0
         self._running = False              #: guarded_by _cond
         self._thread = None                #: guarded_by _cond
+        # queue observatory: items waiting for a merged dispatch vs the
+        # early-out bound (an unlocked sum over a short list — a torn
+        # read costs one slightly-stale gauge sample)
+        self._queue_probe = queue_obs.register(
+            "verifier.coalesce", self,
+            depth=lambda c: sum(call.n for call in c._queue),
+            capacity=max_batch)
 
     # ------------------------------------------------------------ callers
 
@@ -204,6 +212,7 @@ class DispatchCoalescer:
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop the dispatcher; queued calls are still dispatched."""
+        self._queue_probe.close()
         with self._cond:
             self._closed = True
             self._cond.notify_all()

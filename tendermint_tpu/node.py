@@ -81,6 +81,15 @@ class Node:
                              f"pid{os.getpid()}")
         self._stall_detector = None
 
+        # runtime introspection plane (env wins inside each resolve):
+        # sampling profiler + queue observatory, both process-global —
+        # several in-process nodes share one sampler and one catalog
+        from tendermint_tpu.telemetry import profile as _profile
+        from tendermint_tpu.telemetry import queues as _queues
+        _profile.configure(mode=getattr(config.base, "prof", "off"),
+                           hz=getattr(config.base, "prof_hz", 0.0))
+        _queues.configure(mode=getattr(config.base, "queue_watch", "on"))
+
         def db_path(name):
             if in_memory:
                 return None
@@ -404,6 +413,15 @@ class Node:
                 lambda: self.height, self._on_stall, stall_s)
             self._stall_detector.start()
 
+        # runtime introspection: start the sampler when TM_TPU_PROF
+        # says so, and the queue-observatory watcher whenever the
+        # observatory is on (both process-global daemons — in-process
+        # testnets share them; node.stop() leaves them for peers)
+        from tendermint_tpu.telemetry import profile as _profile
+        from tendermint_tpu.telemetry import queues as _queues
+        _profile.maybe_start()
+        _queues.ensure_watch()
+
         # HTTP and gRPC listeners are independent: asking for one must
         # not bind the other (a gRPC-only operator should not get the
         # full JSON-RPC surface on the config-default 0.0.0.0 address)
@@ -460,8 +478,16 @@ class Node:
         import time as _time
         from tendermint_tpu.rpc import RPCCore, RPCEnv
         from tendermint_tpu.telemetry import causal as _causal
+        from tendermint_tpu.telemetry import profile as _profile
+        from tendermint_tpu.telemetry import queues as _queues
         doc = {"height": height, "stalled_s": round(stalled_s, 3),
-               "timeline": _causal.dump()}
+               "timeline": _causal.dump(),
+               # self-diagnosing capture: WHERE the threads are (the
+               # profiler's table, whatever it has collected) and WHICH
+               # queue backed up first (the observatory's high-water
+               # table) ride along with the what-happened timeline
+               "profile": _profile.snapshot(),
+               "queues": _queues.table()}
         try:
             core = RPCCore(RPCEnv.from_node(self))
             doc["consensus"] = core.dump_consensus_state()

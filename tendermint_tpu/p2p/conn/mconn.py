@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional
 from tendermint_tpu import telemetry
 from tendermint_tpu.p2p.conn import burst as burst_cfg
 from tendermint_tpu.p2p.conn.flowrate import FlowMonitor
+from tendermint_tpu.telemetry import queues as queue_obs
 
 _m_frames_per_burst = telemetry.histogram(
     "p2p_frames_per_burst",
@@ -120,6 +121,17 @@ class MConnection:
         self._burst_on, self._burst_max = burst_cfg.resolve()
         self._burst_write = self._burst_on and hasattr(link, "write_many")
         self._burst_read = self._burst_on and hasattr(link, "read_burst")
+        # queue observatory: one probe per channel send queue, keyed by
+        # channel id so the saturation verdict names WHICH plane backs
+        # up (0x20 consensus-state vs 0x21 votes vs 0x40 blocks...).
+        # Probes weak-ref this connection; a dead conn drops off the
+        # catalog at the next sweep, stop() removes them promptly.
+        self._queue_probes = [
+            queue_obs.register(
+                f"mconn.send.{d.id:#04x}", self,
+                depth=lambda c, _id=d.id: len(c.channels[_id].queue),
+                capacity=d.send_queue_capacity)
+            for d in channel_descs]
 
     # ---------------------------------------------------------------- control
 
@@ -141,6 +153,8 @@ class MConnection:
             already = self._stopped
             self._stopped = True
             self._cond.notify_all()
+        for probe in self._queue_probes:
+            probe.close()
         if not already:
             try:
                 self.link.close()

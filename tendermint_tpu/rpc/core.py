@@ -40,7 +40,8 @@ class RPCEnv:
                  mempool=None, evidence_pool=None, switch=None,
                  event_bus=None, tx_indexer=None, gen_doc=None,
                  app_conns=None, pubkey: bytes = b"", unsafe: bool = False,
-                 blockchain_reactor=None):
+                 blockchain_reactor=None, statesync_reactor=None,
+                 snapshot_store=None, stall_detector=None):
         self.consensus = consensus
         self.block_store = block_store
         self.state_store = state_store
@@ -54,6 +55,9 @@ class RPCEnv:
         self.pubkey = pubkey
         self.unsafe = unsafe
         self.blockchain_reactor = blockchain_reactor
+        self.statesync_reactor = statesync_reactor
+        self.snapshot_store = snapshot_store
+        self.stall_detector = stall_detector
 
     @classmethod
     def from_node(cls, node) -> "RPCEnv":
@@ -67,7 +71,10 @@ class RPCEnv:
             pubkey=(node.consensus.priv_validator.pubkey.ed25519
                     if node.consensus.priv_validator else b""),
             unsafe=node.config.rpc.unsafe,
-            blockchain_reactor=getattr(node, "blockchain_reactor", None))
+            blockchain_reactor=getattr(node, "blockchain_reactor", None),
+            statesync_reactor=getattr(node, "statesync_reactor", None),
+            snapshot_store=getattr(node, "snapshot_store", None),
+            stall_detector=getattr(node, "_stall_detector", None))
 
 
 class RPCCore:
@@ -100,6 +107,8 @@ class RPCCore:
             "tx_search": self.tx_search,
             "metrics": self.metrics,
             "dump_height_timeline": self.dump_height_timeline,
+            "debug_profile": self.debug_profile,
+            "healthz": self.healthz,
         }
         if self.env.unsafe:
             r.update({
@@ -446,6 +455,83 @@ class RPCCore:
             d["height"] = cs.state.last_block_height
         return jsonify(d)
 
+    def debug_profile(self, action: str = "status",
+                      hz: float = 0.0) -> dict:
+        """The sampling profiler (telemetry/profile.py) over RPC:
+        status | start [hz] | stop | dump. `dump` returns the
+        collapsed-stack text plus per-subsystem busy/lock-wait sample
+        counts — the payload scripts/profile_merge.py merges across
+        nodes. Raw consumers use GET /debug/pprof instead (collapsed
+        text, no JSON envelope)."""
+        from tendermint_tpu.telemetry import causal, profile
+        action = (action or "status").strip().lower()
+        if action == "start":
+            p = profile.start(hz=hz or None)
+            return {"running": True, "hz": p.hz}
+        if action == "stop":
+            p = profile.stop()
+            return {"running": False,
+                    "samples": 0 if p is None else p.snapshot()["samples"]}
+        if action == "dump":
+            doc = profile.snapshot()
+            doc["node"] = causal.node()
+            return doc
+        if action != "status":
+            raise RPCError(-32602, f"unknown action {action!r} "
+                           f"(status|start|stop|dump)")
+        doc = profile.snapshot()
+        doc.pop("collapsed", None)  # status is the cheap probe
+        doc["node"] = causal.node()
+        return doc
+
+    def healthz(self) -> dict:
+        """One JSON verdict for load balancers and operators: height
+        progress, queue saturation (telemetry/queues.py catalog), the
+        stall detector's episode state, the profiler's top-5 busy
+        subsystems, and sync/snapshot status. `ok` is false while any
+        queue sits saturated or the chain is stalled — the conditions
+        the triage playbook (docs/observability.md) starts from."""
+        from tendermint_tpu.telemetry import profile, queues
+        cs = self.env.consensus
+        sd = self.env.stall_detector
+        saturated = queues.saturated()
+        stalled = bool(sd is not None and sd.stalled)
+        prof = profile.get()
+        syncing = (self.env.blockchain_reactor is not None and
+                   not self.env.blockchain_reactor.synced)
+        doc = {
+            "ok": not saturated and not stalled,
+            "height": cs.state.last_block_height
+            if cs is not None else 0,
+            "syncing": syncing,
+            "queues": {"saturated": saturated,
+                       "table": queues.table()},
+            "stall": {"stalled": stalled,
+                      "episodes": 0 if sd is None else sd.fired,
+                      "window_s": None if sd is None else sd.window_s},
+            "profile": {
+                "enabled": profile.enabled(),
+                "running": bool(prof is not None and prof.running),
+                "top": prof.top(5) if prof is not None else [],
+            },
+        }
+        ss = self.env.snapshot_store
+        if ss is not None:
+            try:
+                heights = ss.list_heights()
+                doc["snapshot"] = {
+                    "latest_height": max(heights) if heights else 0,
+                    "count": len(heights)}
+            except Exception as e:
+                doc["snapshot"] = {"error": repr(e)}
+        sr = self.env.statesync_reactor
+        if sr is not None and hasattr(sr, "status"):
+            try:
+                doc["statesync"] = sr.status()
+            except Exception as e:
+                doc["statesync"] = {"error": repr(e)}
+        return jsonify(doc)
+
     def unsafe_dump_trace(self, filename: str = "") -> dict:
         """Write the in-memory consensus/verifier timeline as
         Chrome-trace JSON (chrome://tracing, ui.perfetto.dev)."""
@@ -587,4 +673,16 @@ def make_server(env: RPCEnv):
     # raw GET /debug/timeline: the causal span ring as JSON (curl-able
     # without a JSON-RPC envelope; same payload as dump_height_timeline)
     server.timeline_provider = core.dump_height_timeline
+    # raw GET /healthz (JSON verdict for load balancers) and GET
+    # /debug/pprof (flamegraph collapsed-stack text, the Go-pprof
+    # convention path) — plain-HTTP consumers, no JSON-RPC envelope
+    from tendermint_tpu.telemetry import profile
+
+    def _pprof_text() -> str:
+        p = profile.get()
+        return "" if p is None else p.collapsed()
+
+    server.raw_routes["/healthz"] = ("application/json", core.healthz)
+    server.raw_routes["/debug/pprof"] = (
+        "text/plain; charset=utf-8", _pprof_text)
     return server, core

@@ -296,6 +296,12 @@ class RPCServer:
         # when set, GET /debug/timeline serves this callable's dict as
         # JSON — the causal span ring for trace_merge/curl consumers
         self.timeline_provider: Optional[Callable[[], dict]] = None
+        # additional raw GET paths: path -> (content_type, provider).
+        # A str/bytes result is served verbatim with that content type;
+        # a dict result is served as JSON. /healthz and /debug/pprof
+        # live here — load balancers and profile_merge speak plain
+        # HTTP, not JSON-RPC envelopes.
+        self.raw_routes: Dict[str, tuple] = {}
 
     def register(self, name: str, fn: Callable, ws_only: bool = False) -> None:
         self.funcs[name] = RPCFunc(fn, ws_only=ws_only)
@@ -395,6 +401,26 @@ class RPCServer:
                         self._reply(_rpc_response(None, error=RPCError(
                             -32603, f"timeline provider failed: {e}")),
                             500)
+                    return
+                if url.path in server.raw_routes:
+                    ctype, provider = server.raw_routes[url.path]
+                    try:
+                        result = provider()
+                    except Exception as e:
+                        self._reply(_rpc_response(None, error=RPCError(
+                            -32603, f"{url.path} provider failed: "
+                                    f"{e}")), 500)
+                        return
+                    if isinstance(result, dict):
+                        self._reply(result)
+                        return
+                    body = result.encode() if isinstance(result, str) \
+                        else bytes(result)
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 method = url.path.strip("/")
                 if method == "":

@@ -223,6 +223,33 @@ class StateSyncReactor(Reactor):
         self._manifest_waiting: Optional[Tuple[int, str]] = None
         self._pending: Set[int] = set()             # chunk indexes left
         self._inflight: Dict[int, Tuple[str, float]] = {}
+        # queue observatory: chunks still owed against the manifest's
+        # total — a restore that sits saturated is fetch-starved (few
+        # advertisers, banned peers, or backoff), the docs' triage
+        # entry for slow bootstraps
+        from tendermint_tpu.telemetry import queues as queue_obs
+        self._queue_probe = queue_obs.register(
+            "sync.chunks", self,
+            depth=lambda r: len(r._pending) + len(r._inflight),
+            capacity=lambda r: len((r._manifest or {}).get(
+                "chunks", ())) or 1)
+
+    def status(self) -> dict:
+        """Restore-side progress for /healthz: whether this node is
+        restoring, how many chunks remain, and the outcome once done."""
+        with self._lock:
+            total = len((self._manifest or {}).get("chunks", ()))
+            pending = len(self._pending) + len(self._inflight)
+            return {
+                "restoring": bool(self.restore and
+                                  not self.finished.is_set()),
+                "finished": self.finished.is_set(),
+                "restored": self.restored_state is not None,
+                "chunks_total": total,
+                "chunks_pending": pending,
+                "peers": len(self._peers),
+                "banned": len(self._banned),
+            }
 
     def get_channels(self):
         return [ChannelDescriptor(STATESYNC_CHANNEL, priority=3,
@@ -239,6 +266,7 @@ class StateSyncReactor(Reactor):
 
     def stop(self) -> None:
         self._stopped = True
+        self._queue_probe.close()
         with self._cond:
             self._cond.notify_all()
         t = self._thread

@@ -67,12 +67,13 @@ from tendermint_tpu.utils import knobs
 #   stall            stall detector fired (flight recorder)
 #   snapshot.restore state-sync restore apply (assemble/verify/bootstrap)
 #   sync.chunk       one verified snapshot chunk landed (origin + bytes)
+#   queue.saturated  queue-observatory watchdog episode (kind + depth)
 SPAN_CATALOG = frozenset((
     "height.begin", "propose", "proposal.recv", "part.first",
     "block.full", "quorum.prevote", "quorum.precommit",
     "verify.dispatch", "apply", "flush", "wal.fsync", "commit",
     "p2p.recv", "mempool.recv", "stall",
-    "snapshot.restore", "sync.chunk",
+    "snapshot.restore", "sync.chunk", "queue.saturated",
 ))
 
 DEFAULT_CAPACITY = 65536
@@ -293,6 +294,9 @@ class StallDetector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fired = 0
+        # True from the moment an episode fires until the next height
+        # change — the /healthz verdict's "currently stalled" bit
+        self.stalled = False
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -321,10 +325,12 @@ class StallDetector:
             now = time.monotonic()
             if h != last_h:
                 last_h, last_change, armed = h, now, True
+                self.stalled = False
                 continue
             if armed and now - last_change >= self.window_s:
                 armed = False  # once per episode
                 self.fired += 1
+                self.stalled = True
                 stalled = now - last_change
                 point("stall", h, stalled_s=round(stalled, 3))
                 try:

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from tendermint_tpu import telemetry
+from tendermint_tpu.telemetry import queues as queue_obs
 
 _m_dropped = telemetry.counter(
     "event_dropped_total",
@@ -137,6 +138,13 @@ class Subscription:
         self.dropped = 0
         self._items: "deque[EventItem]" = deque()
         self._cond = threading.Condition()
+        # queue observatory: a saturated subscriber buffer means a slow
+        # consumer is about to lose history (drop-oldest); the probe
+        # weak-refs this subscription, so abandoned subscribers prune
+        # themselves — unsubscribe closes promptly below
+        self._queue_probe = queue_obs.register(
+            "event.subscriber", self, depth=lambda s: len(s._items),
+            capacity=self.capacity)
 
     def put(self, item: EventItem) -> bool:
         """Buffer an event; True when an older one was evicted."""
@@ -199,11 +207,14 @@ class EventBus:
             sub = self._subs.pop(key, None)
             if sub:
                 sub.cancelled = True
+                sub._queue_probe.close()
 
     def unsubscribe_all(self, subscriber: str) -> None:
         with self._lock:
             for key in [k for k in self._subs if k[0] == subscriber]:
-                self._subs.pop(key).cancelled = True
+                sub = self._subs.pop(key)
+                sub.cancelled = True
+                sub._queue_probe.close()
 
     def publish(self, event_type: str, data: Any,
                 tags: Optional[Dict[str, Any]] = None) -> None:

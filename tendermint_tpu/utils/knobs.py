@@ -119,6 +119,23 @@ CATALOG: tuple[Knob, ...] = (
          "for this many seconds dumps timeline + consensus state "
          "(flight recorder).",
          "node.py"),
+    Knob("TM_TPU_PROF", "str", "off", "base.prof",
+         "Sampling profiler: on walks sys._current_frames() at "
+         "TM_TPU_PROF_HZ, attributing samples to subsystems/threads "
+         "(tm_prof_*, /debug/pprof, debug_profile RPC); off = no "
+         "sampler thread, one flag check per entry point.",
+         "telemetry/profile.py"),
+    Knob("TM_TPU_PROF_HZ", "float", "13", "base.prof_hz",
+         "Profiler sampling rate, sweeps per second (default keeps a "
+         "40-thread node under ~1% of a core).",
+         "telemetry/profile.py"),
+    Knob("TM_TPU_QUEUE_WATCH", "spec", "on (0.25s poll)",
+         "base.queue_watch",
+         "Queue observatory: off | on | <poll seconds>. Registers "
+         "every bounded queue into one catalog (tm_queue_* gauges, "
+         "/healthz verdict) with a once-per-episode saturation "
+         "watchdog; off skips registration entirely.",
+         "telemetry/queues.py"),
     # -- recovery plane ----------------------------------------------------
     Knob("TM_TPU_SNAPSHOT_INTERVAL", "int", "0 (off)",
          "base.snapshot_interval",

@@ -292,3 +292,78 @@ def test_commit_cache_invalidates_on_mutation():
     h1 = commit.hash()
     commit.precommits = commit.precommits[:2]
     assert commit.hash() != h1
+
+
+def test_idle_vote_gossip_reannounces_round_step():
+    """Genesis-wedge regression (PR 10): the add_peer NewRoundStep
+    announcement is a try_send into a just-built conn, and receive()
+    drops messages arriving before the peer state registers — either
+    end of the connect race can eat it, leaving the PEER's view of us
+    blank at (0, -1). The side with the stale view cannot know it, so
+    the side with NOTHING TO SEND must re-announce: an idle vote
+    gossip loop re-sends our new_round_step after ~2s, repeatedly,
+    until the peer can place us."""
+    import threading
+    import time
+
+    from tendermint_tpu.consensus.reactor import PeerRoundState
+
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(2)]
+    gen = GenesisDoc(chain_id="reannounce", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    cs = make_validator_node(gen, keys[0])
+    reactor = ConsensusReactor(cs, gossip_sleep_s=0.02)
+
+    sent = []
+
+    class FakePeer:
+        id = "fakepeer"
+        running = True
+
+        def set(self, k, v):
+            pass
+
+        def try_send_obj(self, ch, obj):
+            sent.append((ch, obj))
+            return True
+
+        def send(self, ch, raw):
+            return True
+
+    peer = FakePeer()
+    ps = PeerRoundState()  # blank: the lost-announcement shape
+    reactor.peer_states[peer.id] = ps
+    t = threading.Thread(target=reactor._gossip_votes_routine,
+                         args=(peer, ps), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(obj.get("type") == "new_round_step"
+                   for _, obj in sent):
+                break
+            time.sleep(0.05)
+        announcements = [obj for _, obj in sent
+                         if obj.get("type") == "new_round_step"]
+        assert announcements, "idle gossip never re-announced"
+        assert announcements[0]["height"] == cs.rs.height
+        # and it repeats while the peer stays blank (the first copy
+        # may be lost the same way the add_peer one was)
+        n0 = len(announcements)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len([obj for _, obj in sent
+                    if obj.get("type") == "new_round_step"]) > n0:
+                break
+            time.sleep(0.05)
+        assert len([obj for _, obj in sent
+                    if obj.get("type") == "new_round_step"]) > n0
+        # once the peer's view catches up, the idle loop goes quiet
+        ps.apply_new_round_step({"height": cs.rs.height,
+                                 "round": cs.rs.round,
+                                 "step": int(cs.rs.step),
+                                 "last_commit_round": -1})
+    finally:
+        peer.running = False
+        t.join(timeout=3.0)

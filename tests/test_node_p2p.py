@@ -69,3 +69,4 @@ def test_tx_gossips_between_full_nodes(tmp_path):
     finally:
         for node in nodes:
             node.stop()
+
