@@ -27,12 +27,16 @@ from tendermint_tpu.utils import knobs  # noqa: E402 (post-cache-setup)
 
 
 class _BenchMempool:
-    """Endless reap: always has the next block's txs ready."""
+    """Endless reap: always has the next block's txs ready. `pending`
+    carries real injected txs (the churn driver's val: txs) ahead of
+    the fabricated filler — removed once seen committed, so every
+    node's copy drains in step like a real mempool."""
 
     def __init__(self, n_txs: int):
         self.n_txs = n_txs
         self._next = 0
         self.committed = 0
+        self.pending = []
 
     def lock(self):
         pass
@@ -43,20 +47,38 @@ class _BenchMempool:
     def size(self):
         return self.n_txs
 
+    def inject(self, tx: bytes):
+        if tx not in self.pending:
+            self.pending.append(tx)
+
     def reap(self, max_txs: int):
         base = self._next
         k = self.n_txs if max_txs < 0 else min(self.n_txs, max_txs)
-        return [b"bench/k%d=v%d" % (base + i, i) for i in range(k)]
+        out = list(self.pending[:k])
+        return out + [b"bench/k%d=v%d" % (base + i, i)
+                      for i in range(k - len(out))]
 
     def update(self, height, txs):
         self._next += len(txs)
         self.committed += len(txs)
+        if self.pending:
+            committed = set(txs)
+            self.pending = [t for t in self.pending
+                            if t not in committed]
 
     def txs_available(self):
         return True
 
 
-def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
+def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000,
+        churn_every: int = 0, churn_standby: int = 2) -> dict:
+    """`churn_every` > 0 turns on the validator-churn driver: every
+    that-many committed heights one `val:` tx (join a standby key /
+    stake-change it / leave it, cycling) is injected into every
+    node's mempool — the valset rotates through REAL EndBlock
+    validator_updates while the bench measures. Standby keys run no
+    ConsensusState (a joined-but-absent validator costs rounds when
+    it wins proposer — that cost is part of what churn measures)."""
     from tendermint_tpu.abci.apps import KVStoreApp
     from tendermint_tpu.abci.proxy import AppConns, local_client_creator
     from tendermint_tpu.abci.types import ValidatorUpdate
@@ -68,6 +90,8 @@ def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
     from tendermint_tpu.types.priv_validator import LocalSigner, PrivValidator
 
     keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n_vals)]
+    standby = [PrivKey.generate(bytes([200, i + 1]) * 16)
+               for i in range(churn_standby if churn_every else 0)]
     gen = GenesisDoc(chain_id="bench-net", genesis_time_ns=1,
                      validators=[GenesisValidator(k.pubkey.ed25519, 10)
                                  for k in keys])
@@ -108,10 +132,45 @@ def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
     for node in nodes:
         node.start()
 
+    # churn driver: deterministic op cycle over the standby keys,
+    # advanced by committed height, injected into EVERY mempool (the
+    # next proposer includes it; absolute powers make a duplicate
+    # inclusion idempotent)
+    churn_state = {"next_h": churn_every + 1, "op_i": 0, "ops": 0,
+                   "joined": []}
+
+    def drive_churn():
+        if not churn_every or not standby:
+            return
+        h = min(n.state.last_block_height for n in nodes)
+        if h < churn_state["next_h"]:
+            return
+        churn_state["next_h"] = h + churn_every
+        kind = ("join", "stake", "leave")[churn_state["op_i"] % 3]
+        churn_state["op_i"] += 1
+        tx = None
+        if kind == "join":
+            free = [k for k in standby
+                    if k not in churn_state["joined"]]
+            if free:
+                churn_state["joined"].append(free[0])
+                tx = b"val:%s/10" % free[0].pubkey.ed25519.hex().encode()
+        elif kind == "stake" and churn_state["joined"]:
+            tx = b"val:%s/15" % churn_state["joined"][0] \
+                .pubkey.ed25519.hex().encode()
+        elif kind == "leave" and churn_state["joined"]:
+            k = churn_state["joined"].pop(0)
+            tx = b"val:%s/0" % k.pubkey.ed25519.hex().encode()
+        if tx is not None:
+            churn_state["ops"] += 1
+            for node in nodes:
+                node.mempool.inject(tx)
+
     def run_to(height, max_ticks):
         for _ in range(max_ticks):
             if all(n.state.last_block_height >= height for n in nodes):
                 return True
+            drive_churn()
             fire_all()
         return all(n.state.last_block_height >= height for n in nodes)
 
@@ -127,14 +186,24 @@ def run(n_blocks: int = 30, n_vals: int = 4, n_txs: int = 1000) -> dict:
     blocks = min(n.state.last_block_height for n in nodes) - h0
     txs = nodes[0].mempool.committed - tx0
 
-    for node in nodes:
-        node.stop()
-    return {
+    final_vals = nodes[0].state.validators
+    out = {
         "blocks_per_sec": round(blocks / dt, 2),
         "txs_per_sec": round(txs / dt, 1),
         "blocks": blocks, "n_vals": n_vals, "txs_per_block": n_txs,
         "seconds": round(dt, 3),
     }
+    if churn_every:
+        out["churn"] = {
+            "ops_injected": churn_state["ops"],
+            "final_valset_size": len(final_vals),
+            "final_total_power": final_vals.total_voting_power(),
+            "last_height_validators_changed":
+                nodes[0].state.last_height_validators_changed,
+        }
+    for node in nodes:
+        node.stop()
+    return out
 
 
 def _scrape_p2p_metrics(client) -> dict:

@@ -66,6 +66,23 @@ EXTRACTORS = (
      "profiler_overhead", "fraction", "down"),
     ("chaos_invariant_checks_passed", "BENCH_chaos.json",
      "value", "checks", "up"),
+    # the ISSUE-11 validator-scale curve: commit rate at 32 and 128
+    # validators under churn + wan3 geo + faults, and the
+    # predecompression hit rate where the device path engages (128) —
+    # regressions here mean the adversarial plane got slower or the
+    # cache stopped surviving churn
+    ("chaos_blocks_per_sec_32v", "BENCH_chaos.json",
+     "scaling_curve[n_validators=32].blocks_per_sec", "blocks/sec",
+     "up"),
+    ("chaos_blocks_per_sec_128v", "BENCH_chaos.json",
+     "scaling_curve[n_validators=128].blocks_per_sec", "blocks/sec",
+     "up"),
+    ("chaos_predecomp_hit_rate_128v", "BENCH_chaos.json",
+     "scaling_curve[n_validators=128].predecomp_hit_rate", "fraction",
+     "up"),
+    ("chaos_lite_certified_height_32v", "BENCH_chaos.json",
+     "scaling_curve[n_validators=32].lite.certified_height", "heights",
+     "up"),
     ("mesh_8dev_verifies_per_sec", "BENCH_mesh.json",
      "points[devices=8].verifies_per_sec", "verifies/sec", "up"),
     ("statesync_speedup_vs_replay", "BENCH_sync.json",

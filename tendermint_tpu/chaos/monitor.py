@@ -14,6 +14,16 @@ faults fire:
               DuplicateVoteEvidence committed in a block.
   liveness    after every fault episode heals, the chain commits a new
               height within a bounded number of steps.
+  certified   every committed height is continuously certified by a
+              lite client (lite.ContinuousCertifier) tracking the
+              CHURNING valset height by height — sequential
+              certify/update across every EndBlock valset delta. A
+              commit the light client cannot certify is the loudest
+              possible safety failure: the chain's own proof chain
+              broke. Enabled by attach_lite(); the certifier advances
+              inside poll() as committed heights' data becomes
+              readable from a live node's stores (exactly what an RPC
+              provider would serve).
 
 Violations are recorded (never raised mid-run — the runner must keep
 driving so the trace shows what happened AFTER the violation) and
@@ -31,7 +41,8 @@ from tendermint_tpu import chaos
 from tendermint_tpu.chaos.byzantine import double_sign_key
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 
-INVARIANTS = ("agreement", "validity", "evidence", "liveness")
+INVARIANTS = ("agreement", "validity", "evidence", "liveness",
+              "certified")
 
 
 def _percentiles(xs: List[float]) -> dict:
@@ -59,6 +70,12 @@ class InvariantMonitor:
         self.notes: List[dict] = []
         self.checks: Dict[str, int] = {}
         self.max_height = 0
+        # continuous lite certification (attach_lite)
+        self.lite = None                      # ContinuousCertifier
+        self._lite_provider = None            # height -> FullCommit|None
+        self._lite_active = False
+        self._lite_stuck_since: Optional[int] = None
+        self.lite_valset_sizes: Dict[int, int] = {}
 
     # ------------------------------------------------------------ wiring
 
@@ -73,6 +90,19 @@ class InvariantMonitor:
 
     def detach(self, node_id: int) -> None:
         self._subs.pop(node_id, None)
+
+    def attach_lite(self, chain_id: str, genesis_validators,
+                    provider, verifier=None) -> None:
+        """Turn on continuous lite certification. `provider` is a
+        callable height -> FullCommit | None (None = data not readable
+        yet — retried every poll). The certifier starts from the
+        genesis valset and must cross every EndBlock delta
+        sequentially."""
+        from tendermint_tpu.lite import ContinuousCertifier
+        self.lite = ContinuousCertifier(chain_id, genesis_validators,
+                                        verifier=verifier)
+        self._lite_provider = provider
+        self._lite_active = True
 
     # ------------------------------------------------------------ checking
 
@@ -102,6 +132,41 @@ class InvariantMonitor:
                     break
                 data = item.data
                 self._on_commit(step, node_id, data["block"])
+        self._advance_lite(step)
+
+    def _advance_lite(self, step: int) -> None:
+        """Certify every committed height whose (header, commit,
+        valset) is readable, strictly in order. A height that FAILS
+        certification is a violation and halts the certifier — trust
+        cannot legitimately advance past it, and one loud report beats
+        a violation per remaining height. A height whose data never
+        appears (all its holders crashed) only trips after a patience
+        window, as a note, not a violation: that is missing telemetry,
+        not broken safety."""
+        from tendermint_tpu.lite.types import CertificationError
+        if self.lite is None or not self._lite_active:
+            return
+        while self.lite.next_height <= self.max_height:
+            h = self.lite.next_height
+            fc = self._lite_provider(h)
+            if fc is None:
+                if self._lite_stuck_since is None:
+                    self._lite_stuck_since = step
+                elif step - self._lite_stuck_since > 200:
+                    self.note("lite", f"height {h} unreadable for "
+                              f"{step - self._lite_stuck_since} steps; "
+                              f"certification halted")
+                    self._lite_active = False
+                return
+            self._lite_stuck_since = None
+            self._check("certified")
+            try:
+                self.lite.advance(fc)
+            except CertificationError as e:
+                self._violate("certified", step, height=h, error=str(e))
+                self._lite_active = False
+                return
+            self.lite_valset_sizes[h] = len(fc.validators)
 
     def _on_commit(self, step: int, node_id: int, block) -> None:
         h = block.header.height
@@ -142,6 +207,10 @@ class InvariantMonitor:
         """End-of-run checks + report. `step_seconds` (mean wall time
         per runner step) converts step latencies into seconds for the
         recovery histogram."""
+        # one last certification sweep: the final heights' commits were
+        # saved during the last steps and may not have been readable
+        # when their poll ran
+        self._advance_lite(final_step)
         # evidence: every injected double-sign must be committed
         for key in sorted(self.expected_double_signs):
             self._check("evidence")
@@ -171,6 +240,18 @@ class InvariantMonitor:
 
         lat_s = [x * step_seconds for x in latencies] if step_seconds \
             else []
+        lite = None
+        if self.lite is not None:
+            sizes = self.lite_valset_sizes
+            lite = {
+                "certified_height": self.lite.certified_height,
+                "static_certified": self.lite.static_certified,
+                "valset_updates": self.lite.updates,
+                "final_valset_size": len(self.lite.validators),
+                "valset_size_min": min(sizes.values(), default=0),
+                "valset_size_max": max(sizes.values(), default=0),
+                "active": self._lite_active,
+            }
         return {
             "checks": dict(self.checks),
             "checks_total": sum(self.checks.values()),
@@ -190,6 +271,7 @@ class InvariantMonitor:
                 "latency_seconds": _percentiles(
                     [round(x, 4) for x in lat_s]),
             },
+            **({"lite": lite} if lite is not None else {}),
         }
 
     def dump_trace(self, path: str, schedule, report: Optional[dict] = None

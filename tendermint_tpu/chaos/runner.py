@@ -82,10 +82,54 @@ SMOKE_SPEC = {
 }
 
 
+def scale_spec(n: int, full_churn: bool = True) -> dict:
+    """The validator-scale adversarial scenario for an n-node ChaosNet
+    (BENCH_chaos.json's scaling curve + the slow acceptance tests):
+    light link faults + the wan3 geo profile + valset churn through
+    real EndBlock deltas + one crash-restart. `full_churn=False` trims
+    the churn cycle to join+leave (the 128-validator point, where every
+    extra height costs O(n^2) relay deliveries — the 32-validator
+    acceptance run keeps the full join/leave/stake cycle). stall_assist
+    is on: a WAN-lossy large net relies on the reactor-style
+    re-delivery a real network performs (deterministic, step-scheduled
+    — same (spec, seed) still reproduces one fault log).
+
+    The wan3 bandwidth caps are calibrated per NODE pair at the 4-node
+    shape; the relay's full-mesh traffic grows O(n^2), so the
+    per-region-pair caps scale by (n/4)^2 here — the same
+    per-node-pair pipe budget at every n. Without this a 128-node
+    commit round buries the long-haul pipes 50+ steps deep and the
+    net never exits height 1 (measured, not hypothetical)."""
+    from tendermint_tpu.chaos.schedule import GEO_PROFILES
+    bw_scale = max(1, (n * n) // 16)
+    caps = [[c * bw_scale for c in row]
+            for row in GEO_PROFILES["wan3"]["bandwidth_msgs"]]
+    return {
+        "drop": 0.01,
+        "delay": 0.03,
+        "delay_steps": [1, 2],
+        "geo": {"profile": "wan3", "bandwidth_msgs": caps},
+        "churn": {
+            "start_height": 2,
+            "every_heights": 1 if n >= 64 else 2,
+            "ops": (["join", "leave", "stake"] if full_churn
+                    else ["join", "leave"]),
+            "standby": max(2, n // 16),
+            "max_events": 3 if full_churn else 2,
+            "stake_step": 5,
+        },
+        "crashes": [{"node": min(2, n - 1), "after_height": 2,
+                     "point": "consensus.after_wal_end_height",
+                     "down_steps": 10}],
+        "stall_assist": True,
+    }
+
+
 class ChaosNet:
     def __init__(self, workdir: str, spec: Optional[dict] = None,
                  seed: int = 0, n: int = 4, chain_id: str = "chaos-net",
-                 tx_every: int = 4, assist_every: int = 8):
+                 tx_every: int = 4, assist_every: int = 8,
+                 lite: bool = True):
         from tendermint_tpu.types import (GenesisDoc, GenesisValidator,
                                           PrivKey)
         self.workdir = workdir
@@ -95,12 +139,27 @@ class ChaosNet:
         self.assist_every = assist_every
         self.schedule = FaultSchedule(spec, seed)
         self.monitor = InvariantMonitor()
+        if n > 255:
+            raise ValueError("ChaosNet supports at most 255 nodes")
         self.keys = [PrivKey.generate(bytes([i + 1]) * 32)
                      for i in range(n)]
+        # churn: the trailing `standby` nodes run as full (non-
+        # validator) nodes from genesis — the join candidates the churn
+        # driver rotates INTO the valset through real val: txs
+        churn = self.schedule.churn
+        standby = min(churn["standby"], n - 2) if churn else 0
+        self.n_genesis_validators = n - standby
         self.gen = GenesisDoc(
             chain_id=chain_id, genesis_time_ns=1,
             validators=[GenesisValidator(k.pubkey.ed25519, 10)
-                        for k in self.keys])
+                        for k in self.keys[:self.n_genesis_validators]])
+        # churn driver state (see _drive_churn)
+        self._churn_next_height = churn["start_height"] if churn else 0
+        self._churn_op_i = 0
+        self._churn_events = 0
+        self._churn_last_inject_height = 0
+        self._churn_joined: List[int] = []   # standby idx, join order
+        self.churn_counts: Dict[str, int] = {}
         self.agents = [ByzantineAgent(i, self.keys[i], chain_id,
                                       self.schedule, self.monitor)
                        for i in range(n)]
@@ -133,6 +192,42 @@ class ChaosNet:
         self._t0 = time.perf_counter()
         for i in range(n):
             self.nodes[i] = self._build_node(i)
+        if lite:
+            # continuous lite certification as a first-class invariant:
+            # the certifier follows the churning valset height by
+            # height, reading each height's (header, commit, valset)
+            # from a live node's stores — the same data an RPC provider
+            # serves a real light client
+            from tendermint_tpu.types.validator_set import (Validator,
+                                                            ValidatorSet)
+            genesis_vals = ValidatorSet(
+                [Validator(v.pubkey, v.power)
+                 for v in self.gen.validators])
+            self.monitor.attach_lite(chain_id, genesis_vals,
+                                     self._lite_full_commit)
+
+    def _lite_full_commit(self, height: int):
+        """FullCommit for `height` from any live node that has it (the
+        monitor retries next poll when None — e.g. the only holder is
+        mid-crash)."""
+        from tendermint_tpu.lite.types import FullCommit, SignedHeader
+        for node in self.nodes:
+            if node is None:
+                continue
+            meta = node.block_store.load_block_meta(height)
+            if meta is None:
+                continue
+            commit = node.block_store.load_seen_commit(height) \
+                or node.block_store.load_block_commit(height)
+            if commit is None:
+                continue
+            try:
+                vals = node.state_store.load_validators(height)
+            except (KeyError, ValueError, LookupError):
+                continue
+            return FullCommit(
+                SignedHeader(meta.header, commit, meta.block_id), vals)
+        return None
 
     # --------------------------------------------------------------- assembly
 
@@ -157,7 +252,32 @@ class ChaosNet:
         else:
             pv = PrivValidatorFile(pv_path, self.keys[i])
             pv._persist()
-        node = Node(test_config(home), self.gen, priv_validator=pv,
+        cfg = test_config(home)
+        if self.schedule.geo is not None:
+            # WAN-calibrated timeouts, exactly what an operator does:
+            # stretch prevote/precommit/propose to cover the profile's
+            # worst hop + jitter. Without this, any net where two near
+            # regions alone hold >2/3 of the power (e.g. 128 nodes
+            # over wan3: 86/128 = 67.2%) reaches +2/3-of-ANY on
+            # near-region prevotes, fires the test config's 1-step
+            # prevote timeout before the far region's votes can cross
+            # the 5-6-step long haul, and nil-precommits every round
+            # forever (measured: 40 rounds of livelock at n=128).
+            from dataclasses import replace
+            g = self.schedule.geo
+            worst = max(max(row) for row in g["latency_steps"]) \
+                + g["jitter_steps"]
+            q_ms = 10  # StepTicker quantum (quantum_s=0.01)
+            c = cfg.consensus
+            cfg.consensus = replace(
+                c,
+                timeout_propose=max(c.timeout_propose,
+                                    (worst + 4) * q_ms),
+                timeout_prevote=max(c.timeout_prevote,
+                                    (worst + 2) * q_ms),
+                timeout_precommit=max(c.timeout_precommit,
+                                      (worst + 2) * q_ms))
+        node = Node(cfg, self.gen, priv_validator=pv,
                     app=KVStoreApp())
         node.consensus.ticker.stop()
         node.consensus.ticker = StepTicker(
@@ -265,6 +385,8 @@ class ChaosNet:
                 except (TxAlreadyInCache, MempoolFull):
                     pass  # dup after restart replay / mempool full
 
+        self._drive_churn()
+
         for i, node in enumerate(self.nodes):
             if node is not None:
                 self._interact(
@@ -276,6 +398,90 @@ class ChaosNet:
         self._deliver_due()
         self._assist()
         self.monitor.poll(t)
+
+    # ----------------------------------------------------------------- churn
+
+    def _frontier_app_valset(self):
+        """(pubkey -> power) as the frontier node's APP knows it — the
+        authoritative applied-plus-pending view (the app advances its
+        set at DeliverTx time), read from the live node with the
+        highest committed height (lowest id breaks ties, so the choice
+        is deterministic)."""
+        best = None
+        for i, node in enumerate(self.nodes):
+            if node is None:
+                continue
+            h = self._height(i)
+            if best is None or h > best[0]:
+                best = (h, node)
+        return (best[0], dict(best[1].app._validators)) if best \
+            else (0, {})
+
+    def _drive_churn(self) -> None:
+        """Rotate the valset through REAL consensus: every
+        `every_heights` committed heights, inject one `val:` tx (the
+        KVStore valset-change surface) into every live mempool — the
+        next proposer includes it, EndBlock returns the delta, and
+        update_with_changes applies it on every node. Deterministic:
+        target selection reads only the frontier app's applied set and
+        fixed orderings."""
+        churn = self.schedule.churn
+        if not churn or self._churn_events >= churn["max_events"]:
+            return
+        h, view = self._frontier_app_valset()
+        if h < self._churn_next_height:
+            return
+        ops = churn["ops"]
+        op = ops[self._churn_op_i % len(ops)]
+        self._churn_op_i += 1
+        self._churn_next_height = h + churn["every_heights"]
+        standby_range = range(self.n_genesis_validators, self.n)
+        tx = None
+        if op == "join":
+            for i in standby_range:
+                pk = self.keys[i].pubkey.ed25519
+                if pk not in view:
+                    tx = b"val:%s/10" % pk.hex().encode()
+                    self._churn_joined.append(i)
+                    break
+        elif op == "leave":
+            # leave the earliest still-active joined standby; fall back
+            # to the highest-index genesis validator, never below 3
+            target = None
+            for i in self._churn_joined:
+                if self.keys[i].pubkey.ed25519 in view:
+                    target = i
+                    break
+            if target is None and len(view) > 3:
+                for i in reversed(range(self.n_genesis_validators)):
+                    if self.keys[i].pubkey.ed25519 in view:
+                        target = i
+                        break
+            if target is not None and len(view) > 1:
+                if target in self._churn_joined:
+                    self._churn_joined.remove(target)
+                pk = self.keys[target].pubkey.ed25519
+                tx = b"val:%s/0" % pk.hex().encode()
+        else:  # stake change: bump the lowest-address active validator
+            pk = min(view) if view else None
+            if pk is not None:
+                tx = b"val:%s/%d" % (pk.hex().encode(),
+                                     view[pk] + churn["stake_step"])
+        if tx is None:
+            return
+        self._churn_events += 1
+        self._churn_last_inject_height = h
+        kind = f"churn_{op}"
+        self.churn_counts[kind] = self.churn_counts.get(kind, 0) + 1
+        self.schedule.record(kind, self.t, height=h,
+                             tx=tx.decode()[:80])
+        for node in self.nodes:
+            if node is None:
+                continue
+            try:
+                node.mempool.check_tx(tx)
+            except (TxAlreadyInCache, MempoolFull):
+                pass
 
     def _route_outbox(self) -> None:
         outbox, self._outbox = self._outbox, []
@@ -420,6 +626,19 @@ class ChaosNet:
             return False
         if any(t < b.get("stop", 0) for b in self.schedule.byzantine):
             return False
+        churn = self.schedule.churn
+        if churn:
+            if self._churn_events < min(churn["max_events"],
+                                        len(churn["ops"])):
+                return False  # at least one full op cycle must fire
+            # ...and the last injected churn tx must have had heights
+            # to commit AND take effect (EndBlock delta applies at
+            # injection height + 2 at the earliest), so "applied
+            # through consensus" is observable before the run stops
+            frontier = max((self._height(i) for i in range(self.n)
+                            if self.nodes[i] is not None), default=0)
+            if frontier < self._churn_last_inject_height + 3:
+                return False
         return True
 
     def report(self, liveness_bound: int = 150) -> dict:
@@ -435,6 +654,23 @@ class ChaosNet:
         rep["faults_injected"] = dict(self.schedule.counts)
         rep["faults_injected_total"] = sum(self.schedule.counts.values())
         rep["catchup_assists"] = self.assists
+        rep["n_nodes"] = self.n
+        rep["n_genesis_validators"] = self.n_genesis_validators
+        rep["blocks_per_sec"] = round(rep["max_height"] / wall, 3) \
+            if wall > 0 else 0.0
+        if self.schedule.churn:
+            rep["churn"] = dict(self.churn_counts)
+            rep["churn"]["events"] = self._churn_events
+        if self.schedule.geo:
+            rep["geo_regions"] = self.schedule.geo["regions"]
+        # determinism witness: sha256 over the canonical fault log —
+        # two runs of one (spec, seed) must produce equal hashes
+        # (cheaper to compare/commit than the full log)
+        import hashlib
+        import json as _json
+        rep["fault_log_sha256"] = hashlib.sha256(
+            _json.dumps(self.schedule.log, sort_keys=True)
+            .encode()).hexdigest()
         return rep
 
 
@@ -450,7 +686,8 @@ def _msg_height(m: dict) -> int:
 def run_chaos(spec: Optional[dict] = None, seed: int = 42,
               workdir: Optional[str] = None, n: int = 4,
               target_height: int = 10, max_steps: int = 800,
-              trace_path: Optional[str] = None) -> dict:
+              trace_path: Optional[str] = None, lite: bool = True,
+              settle_steps: int = 60) -> dict:
     """One seeded chaos run end to end; returns the monitor report
     (plus fault counts). Used by bench.py --chaos-json and the tests.
     On any violation a replayable trace is dumped next to the workdir
@@ -473,10 +710,21 @@ def run_chaos(spec: Optional[dict] = None, seed: int = 42,
     trace_prev = causal._configured
     causal.configure("on")
     causal.clear()
-    net = ChaosNet(workdir, spec, seed, n=n)
+    # the runner is SINGLE-THREADED by design (one seed, one
+    # trajectory), so the dispatch coalescer can never merge anything
+    # here — but every per-vote verify would still pay its cross-thread
+    # handoff + linger (measured ~2x step cost at 64 validators).
+    # Verdicts are identical either way (off-hatch is byte-parity,
+    # test-pinned in test_coalescer); restored after the run.
+    from tendermint_tpu.models.verifier import default_verifier
+    _shared_verifier = default_verifier()
+    coalesce_prev = _shared_verifier.coalesce
+    _shared_verifier.coalesce = "off"
+    net = ChaosNet(workdir, spec, seed, n=n, lite=lite)
     try:
         net.start()
-        net.run(target_height, max_steps=max_steps)
+        net.run(target_height, max_steps=max_steps,
+                settle_steps=settle_steps)
         report = net.report()
         if lockcheck:
             report["lockwatch"] = lockwatch.report()
@@ -500,6 +748,7 @@ def run_chaos(spec: Optional[dict] = None, seed: int = 42,
         return report
     finally:
         net.stop()
+        _shared_verifier.coalesce = coalesce_prev
         causal.configure(trace_prev)
         if own_dir:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -28,11 +28,27 @@ Spec (plain dict, JSON-serializable so traces can embed it):
       "byzantine": [            # see chaos.byzantine for behaviors
         {"node": 0, "behavior": "equivocate", "start": 5, "stop": 80}
       ],
+      "geo": {"profile": "wan3"},        # named latency/bandwidth/loss
+                                # matrices over node pairs (regions
+                                # assigned round-robin unless "assign"
+                                # maps node -> region); or inline
+                                # matrices under the same keys as a
+                                # GEO_PROFILES entry
+      "churn": {                # validator-set rotation driven by the
+        "start_height": 2,      # runner through REAL val: txs (EndBlock
+        "every_heights": 2,     # validator_updates — consensus applies
+        "ops": ["join", "leave", "stake"],   # the deltas, not a test
+        "standby": 2,           # trailing nodes kept OUT of genesis
+        "max_events": 8,        # (join candidates); see runner
+        "stake_step": 5,
+      },
     }
 
 Every field is optional; omitted faults never fire. Crash points must
 name a utils/fail.py COMMIT_POINTS entry — a typo would silently never
-crash, so the constructor validates them.
+crash, so the constructor validates them. Geo and churn draw from the
+seeded RNG ONLY when configured, so every pre-existing spec's fault
+log stays byte-identical (pinned by test_pinned_spec_signatures).
 """
 
 from __future__ import annotations
@@ -43,6 +59,47 @@ from typing import Dict, List, Optional
 from tendermint_tpu.utils.fail import COMMIT_POINTS, RECOVERY_POINTS
 
 _RATE_KEYS = ("drop", "delay", "duplicate", "reorder")
+
+# -- geo profiles -----------------------------------------------------------
+# Named WAN shapes: per-region-pair latency (in runner steps — the test
+# config's 100ms propose timeout is 10 steps, so a 3-5-step cross-
+# region hop is a realistic fraction of a round), jitter, loss
+# probability, and a bandwidth cap (messages per step per directed
+# region pair; 0 = unlimited — intra-region links are never capped).
+# The diagonal is the intra-region link. Matrices need not be
+# symmetric (real WAN routes aren't).
+GEO_PROFILES = {
+    # 3-region WAN: two nearby regions (e.g. us-east/us-west) and one
+    # far one (apac) with a lossier, thinner long-haul link
+    "wan3": {
+        "latency_steps": [[0, 2, 5],
+                          [2, 0, 4],
+                          [5, 4, 0]],
+        "jitter_steps": 1,
+        "loss": [[0.0, 0.005, 0.02],
+                 [0.005, 0.0, 0.01],
+                 [0.02, 0.01, 0.0]],
+        "bandwidth_msgs": [[0, 96, 48],
+                           [96, 0, 64],
+                           [48, 64, 0]],
+    },
+    # 2-region split: one ocean between two halves of the valset
+    "wan2": {
+        "latency_steps": [[0, 4],
+                          [4, 0]],
+        "jitter_steps": 1,
+        "loss": [[0.0, 0.01],
+                 [0.01, 0.0]],
+        "bandwidth_msgs": [[0, 64],
+                           [64, 0]],
+    },
+}
+
+_GEO_KEYS = ("profile", "assign", "latency_steps", "jitter_steps",
+             "loss", "bandwidth_msgs")
+_CHURN_OPS = ("join", "leave", "stake")
+_CHURN_KEYS = ("start_height", "every_heights", "ops", "standby",
+               "max_events", "stake_step")
 
 
 class FaultSchedule:
@@ -69,10 +126,127 @@ class FaultSchedule:
         self.clock_skew: Dict[int, int] = {
             int(k): int(v) for k, v in spec.get("clock_skew", {}).items()}
         self.byzantine = [dict(b) for b in spec.get("byzantine", ())]
+        self.geo = self._resolve_geo(spec.get("geo"))
+        self.churn = self._resolve_churn(spec.get("churn"))
+        # bandwidth bookkeeping: (src_region, dst_region) -> [step, used]
+        self._bw_used: Dict[tuple, list] = {}
         # fault event log: the replayable record (and the determinism
         # witness — two runs with one seed must produce equal logs)
         self.log: List[dict] = []
         self.counts: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ validation
+
+    @staticmethod
+    def _resolve_geo(g) -> Optional[dict]:
+        """Resolve the geo spec into concrete matrices; None when the
+        spec has no geo key. Validates loudly: a typoed profile name or
+        a ragged matrix silently injecting nothing would defeat the
+        run."""
+        if not g:
+            return None
+        g = dict(g)
+        for k in g:
+            if k not in _GEO_KEYS:
+                raise ValueError(f"unknown geo spec key {k!r} "
+                                 f"(known: {_GEO_KEYS})")
+        prof = {}
+        if "profile" in g:
+            name = g.pop("profile")
+            if name not in GEO_PROFILES:
+                raise ValueError(
+                    f"unknown geo profile {name!r} "
+                    f"(known: {sorted(GEO_PROFILES)})")
+            prof = dict(GEO_PROFILES[name])
+        prof.update(g)
+        lat = prof.get("latency_steps")
+        if not lat:
+            raise ValueError("geo spec needs a profile or latency_steps")
+        n = len(lat)
+        out = {
+            "latency_steps": [[int(x) for x in row] for row in lat],
+            "jitter_steps": int(prof.get("jitter_steps", 0)),
+            "loss": [[float(x) for x in row]
+                     for row in prof.get("loss", [[0.0] * n] * n)],
+            "bandwidth_msgs": [[int(x) for x in row] for row in
+                               prof.get("bandwidth_msgs",
+                                        [[0] * n] * n)],
+            "assign": {int(k): int(v) for k, v in
+                       dict(prof.get("assign") or {}).items()},
+            "regions": n,
+        }
+        for key in ("latency_steps", "loss", "bandwidth_msgs"):
+            m = out[key]
+            if len(m) != n or any(len(row) != n for row in m):
+                raise ValueError(f"geo {key} must be {n}x{n}")
+        return out
+
+    @staticmethod
+    def _resolve_churn(c) -> Optional[dict]:
+        if not c:
+            return None
+        c = dict(c)
+        for k in c:
+            if k not in _CHURN_KEYS:
+                raise ValueError(f"unknown churn spec key {k!r} "
+                                 f"(known: {_CHURN_KEYS})")
+        ops = [str(o) for o in c.get("ops", _CHURN_OPS)]
+        for o in ops:
+            if o not in _CHURN_OPS:
+                raise ValueError(f"unknown churn op {o!r} "
+                                 f"(known: {_CHURN_OPS})")
+        return {
+            "start_height": int(c.get("start_height", 2)),
+            "every_heights": max(1, int(c.get("every_heights", 2))),
+            "ops": ops,
+            "standby": int(c.get("standby", 0)),
+            "max_events": int(c.get("max_events", 8)),
+            "stake_step": int(c.get("stake_step", 5)),
+        }
+
+    # ------------------------------------------------------------------- geo
+
+    def region_of(self, node: int) -> int:
+        """Node -> region: explicit assignment, else round-robin (which
+        spreads every region across the id space, so partitions/crashes
+        by node id stay region-diverse)."""
+        if self.geo is None:
+            return 0
+        return self.geo["assign"].get(node, node % self.geo["regions"])
+
+    def _geo_deliveries(self, step: int, src: int, dst: int,
+                        msg_type: str, delays: List[int]) -> List[int]:
+        """Overlay the geo link on base delivery decisions: loss can
+        still drop it, latency+jitter shift every copy, and the
+        bandwidth cap spills overflow into later steps. Runs ONLY when
+        a geo spec is configured — the RNG stream (and so every pinned
+        fault log) is untouched otherwise."""
+        g = self.geo
+        rs, rd = self.region_of(src), self.region_of(dst)
+        if rs == rd and not g["latency_steps"][rs][rd]:
+            return delays  # intra-region: free, uncapped
+        if g["loss"][rs][rd] and self._rng.random() < g["loss"][rs][rd]:
+            self.record("geo_drop", step, src=src, dst=dst,
+                        msg=msg_type, link=f"{rs}->{rd}")
+            return []
+        base = g["latency_steps"][rs][rd]
+        if g["jitter_steps"]:
+            base += self._rng.randint(0, g["jitter_steps"])
+        cap = g["bandwidth_msgs"][rs][rd]
+        if cap:
+            used = self._bw_used.setdefault((rs, rd), [step, 0])
+            if used[0] != step:
+                used[0], used[1] = step, 0
+            used[1] += len(delays)
+            over = (used[1] - 1) // cap
+            if over:
+                # queueing delay: the k-th capful this step departs k
+                # steps later — a thin long-haul pipe, not a drop
+                base += over
+                self.record("geo_throttle", step, src=src, dst=dst,
+                            msg=msg_type, link=f"{rs}->{rd}",
+                            spill_steps=over)
+        return [d + base for d in delays]
 
     # ---------------------------------------------------------------- record
 
@@ -108,6 +282,12 @@ class FaultSchedule:
         if r["duplicate"] and self._rng.random() < r["duplicate"]:
             out.append(delay + self._rng.randint(0, 2))
             self.record("duplicate", step, src=src, dst=dst, msg=msg_type)
+        if self.geo is not None:
+            # geo rides UNDER the link faults at the relay — the only
+            # delivery path, so no conn (burst or otherwise) bypasses
+            # the WAN shape; geo latency is topology, not a fault, so
+            # only its losses/throttles enter the fault log
+            out = self._geo_deliveries(step, src, dst, msg_type, out)
         return out
 
     # ------------------------------------------------------------ partitions

@@ -1,4 +1,5 @@
 from tendermint_tpu.lite.certifier import (
+    ContinuousCertifier,
     DynamicCertifier,
     InquiringCertifier,
     StaticCertifier,
@@ -18,7 +19,8 @@ from tendermint_tpu.lite.types import (
     ValidatorsChangedError,
 )
 
-__all__ = ["CacheProvider", "CertificationError", "DynamicCertifier",
+__all__ = ["CacheProvider", "CertificationError", "ContinuousCertifier",
+           "DynamicCertifier",
            "FileProvider", "FullCommit", "HTTPProvider",
            "InquiringCertifier", "MemProvider", "SecureClient",
            "SignedHeader", "StaticCertifier", "ValidatorsChangedError",

@@ -8,6 +8,13 @@ InquiringCertifier— auto-updates through a Provider with BISECTION over
                     heights when the valset moved too far at once
                     (lite/inquiring_certifier.go:15,67,137-163)
 
+ContinuousCertifier— tracks a CHURNING valset height by height:
+                    sequential certify/update across every valset
+                    delta, never skipping a height — the chaos
+                    monitor's continuous-certification invariant
+                    (every committed height provably safe for a light
+                    client following the chain live).
+
 certify_chain     — the TPU batch path: certify a whole run of
                     consecutive FullCommits with ONE pooled signature
                     dispatch (BASELINE.json config 5's workload).
@@ -138,6 +145,109 @@ class InquiringCertifier:
                 f"cannot bridge trust: no commits in ({lo}, {mid_h}]")
         self._update_to(mid, depth + 1)
         self._update_to(fc, depth + 1)
+
+
+def _trusted_set_endorsement(trusted: ValidatorSet, chain_id: str,
+                             block_id, height: int, commit,
+                             verifier=None) -> None:
+    """Trust-level endorsement for a valset transition (the later-
+    Tendermint light-client rule, trust_level = 1/3): among the
+    commit's votes for `block_id`, those cast by validators the
+    TRUSTED set knows must verify and carry STRICTLY more than 1/3 of
+    the trusted set's power — under the <1/3-byzantine assumption at
+    least one honest trusted validator vouches for the new set.
+    Raises ValueError. Used by ContinuousCertifier, whose transitions
+    are single EndBlock deltas; the v0.16 VerifyCommitAny overlap rule
+    (DynamicCertifier.update) remains the JUMP bridge — it counts only
+    overlap validators toward the new set, which rejects honest
+    quorum-sparse commits the moment one validator joins or leaves."""
+    from tendermint_tpu.models.verifier import default_verifier
+    verifier = verifier or default_verifier()
+    items = []
+    powers = []
+    seen = set()
+    for pc in commit.precommits:
+        if pc is None or pc.block_id != block_id:
+            continue
+        oi, ov = trusted.get_by_address(pc.validator_address)
+        if ov is None or oi in seen:
+            continue  # unknown to the trusted set, or duplicate
+        seen.add(oi)
+        items.append((ov.pubkey, pc.sign_bytes(chain_id), pc.signature))
+        powers.append(ov.voting_power)
+    old_power = 0
+    for valid, power in zip(verifier.verify(items), powers):
+        if not valid:
+            raise ValueError("invalid signature in commit")
+        old_power += power
+    total = trusted.total_voting_power()
+    if not old_power * 3 > total:
+        raise ValueError(
+            f"insufficient trusted-set endorsement: got {old_power}, "
+            f"need > {total / 3:g} (1/3 of trusted power)")
+
+
+class ContinuousCertifier:
+    """Certify EVERY height of a chain whose valset churns, in order.
+
+    Per height: same valset hash as trusted -> plain certify (pooled
+    batch verify). Changed hash -> the adjacent-height transition
+    rule: (1) the commit must carry +2/3 of the NEW (signing) set —
+    ordinary verify_commit, every signer counted; (2) the TRUSTED set
+    must endorse it with >1/3 of its own power among the signers it
+    knows (_trusted_set_endorsement — the later-Tendermint light-
+    client trust level, sound because <1/3 byzantine means at least
+    one honest trusted validator signed the new set into power).
+
+    It NEVER skips a height — feeding a non-consecutive height raises
+    immediately; bridging a gap is DynamicCertifier.update /
+    InquiringCertifier bisection territory, whose strict v0.16 rule
+    refuses any jump that moved more than 1/3 of the trusted power
+    (test-pinned). `trusted` is the valset expected to sign
+    `next_height` (genesis set for next_height=1)."""
+
+    def __init__(self, chain_id: str, trusted: ValidatorSet,
+                 next_height: int = 1, verifier=None):
+        self.chain_id = chain_id
+        self.verifier = verifier
+        self.validators = trusted
+        self.next_height = next_height
+        self.static_certified = 0
+        self.updates = 0          # heights crossed via a valset delta
+
+    @property
+    def certified_height(self) -> int:
+        return self.next_height - 1
+
+    def advance(self, fc: FullCommit) -> None:
+        """Certify fc (which must be the next height) and advance
+        trust. Raises CertificationError on any failure; trust does not
+        advance past a failed height."""
+        if fc.height != self.next_height:
+            raise CertificationError(
+                f"continuous certify expects height {self.next_height}, "
+                f"got {fc.height}")
+        if fc.validators.hash() == self.validators.hash():
+            StaticCertifier(self.chain_id, self.validators,
+                            self.verifier).certify(fc)
+            self.static_certified += 1
+        else:
+            # (1) +2/3 of the signing set, (2) trusted-set endorsement
+            StaticCertifier(self.chain_id, fc.validators,
+                            self.verifier).certify(fc)
+            sh = fc.signed_header
+            try:
+                _trusted_set_endorsement(self.validators, self.chain_id,
+                                         sh.block_id, sh.height,
+                                         sh.commit,
+                                         verifier=self.verifier)
+            except ValueError as e:
+                raise CertificationError(
+                    f"valset transition at height {fc.height}: "
+                    f"{e}") from e
+            self.validators = fc.validators
+            self.updates += 1
+        self.next_height += 1
 
 
 def default_window(n_vals: int) -> int:

@@ -68,6 +68,24 @@ _m_occupancy = telemetry.histogram(
 _m_mesh_devices = telemetry.gauge(
     "verifier_mesh_devices",
     "Devices in the verifier's active sharding mesh (0 = unsharded)")
+# ed25519 predecompression cache (ops/ed25519): registered HERE so the
+# import-light lint can see the families without importing jax; the
+# ops module increments them lazily. hit = batch fully served from
+# cached rows (pre kernel, no sqrt); fill = repeat-traffic batch
+# decompressed once + rows stored; full = mostly-unseen batch routed
+# to the fused full kernel (the churn signature: every valset rotation
+# shows up as full->fill->hit over the next batches).
+_m_predecomp = telemetry.counter(
+    "verifier_predecomp_batches_total",
+    "Device batches through the ed25519 predecompressed-pubkey cache, "
+    "by outcome", ("outcome",))
+_m_predecomp_evictions = telemetry.counter(
+    "verifier_predecomp_evictions_total",
+    "Per-pubkey rows evicted from the ed25519 predecompression LRU "
+    "(valset churn beyond cache capacity)")
+_m_predecomp_keys = telemetry.gauge(
+    "verifier_predecomp_keys",
+    "Pubkey rows currently resident in the predecompression LRU")
 
 # Per-dispatch chunk. The fused pallas kernel tiles batches internally
 # (512/VMEM tile), so big dispatches amortize launch overhead; the sweep

@@ -212,13 +212,42 @@ _predecomp_seen: "OrderedDict[bytes, bool]" = OrderedDict()
 # hit   = batch fully served from cached rows (pre kernel, no sqrt)
 # fill  = repeat-traffic batch decompressed once + rows stored
 # full  = mostly-unseen batch routed to the fused full kernel
-_predecomp_stats = {"hit": 0, "fill": 0, "full": 0}
+# evict = per-pubkey rows dropped by the LRU (valset churn beyond
+#         capacity — invisible before this counter: a rotating valset
+#         quietly degraded every "hit" into a re-fill)
+_predecomp_stats = {"hit": 0, "fill": 0, "full": 0, "evict": 0}
+
+
+def _predecomp_note(outcome: str, n: int = 1) -> None:
+    """Mirror a cache outcome into tm_verifier_predecomp_* telemetry
+    (registered by models/verifier so lint stays import-light; lazy
+    import — models.verifier is loaded in any process that dispatches
+    batches here)."""
+    _predecomp_stats[outcome] += n
+    from tendermint_tpu.models import verifier
+    if outcome == "evict":
+        verifier._m_predecomp_evictions.inc(n)
+    else:
+        verifier._m_predecomp.labels(outcome).inc(n)
+    verifier._m_predecomp_keys.set(len(_predecomp))
 # Batched verifies dispatch concurrently (fast-sync collector, lite
 # certify, RPC handlers all share default_verifier()), and OrderedDict
 # mutation is not thread-safe: a racing popitem against move_to_end can
 # raise KeyError out of verify(), which callers don't treat as a
 # verification failure. One lock guards both cache dicts.
 _predecomp_lock = threading.Lock()
+
+
+def predecomp_stats() -> dict:
+    """Snapshot of the cache outcome counters (bench/report surface):
+    hit/fill/full batch outcomes, row evictions, resident keys, and
+    the batch hit rate."""
+    with _predecomp_lock:
+        s = dict(_predecomp_stats)
+        s["keys"] = len(_predecomp)
+    routed = s["hit"] + s["fill"] + s["full"]
+    s["hit_rate"] = round(s["hit"] / routed, 4) if routed else 0.0
+    return s
 
 
 @jax.jit
@@ -267,7 +296,7 @@ def _verify_cached_predecomp(pk_np, rb, s_bytes, h_bytes):
         if not miss:
             for k in keys:
                 _predecomp.move_to_end(k)
-            _predecomp_stats["hit"] += 1
+            _predecomp_note("hit")
         else:
             fresh = miss - _predecomp_seen.keys()
             for k in fresh:
@@ -277,9 +306,9 @@ def _verify_cached_predecomp(pk_np, rb, s_bytes, h_bytes):
             if fresh:
                 # unseen keys in the batch: fused full kernel (no extra
                 # dispatch); the NEXT batch over these keys fills rows
-                _predecomp_stats["full"] += 1
+                _predecomp_note("full")
                 return None
-            _predecomp_stats["fill"] += 1
+            _predecomp_note("fill")
     if miss:
         # repeat traffic over uncached keys: decompress the whole batch
         # once (outside the lock — device dispatch), store per-key rows.
@@ -293,8 +322,12 @@ def _verify_cached_predecomp(pk_np, rb, s_bytes, h_bytes):
                 if k not in _predecomp:
                     _predecomp[k] = (xnb_h[i].copy(), yb_h[i].copy(),
                                      bool(ok_h[i]))
+            evicted = 0
             while len(_predecomp) > _PREDECOMP_MAX_KEYS:
                 _predecomp.popitem(last=False)
+                evicted += 1
+            if evicted:
+                _predecomp_note("evict", evicted)
     else:
         xnb_h = np.stack([r[0] for r in rows])
         yb_h = np.stack([r[1] for r in rows])

@@ -40,6 +40,101 @@ def test_schedule_rejects_unknown_crash_point():
         FaultSchedule({"crashes": [{"node": 0, "point": "no_such"}]})
 
 
+def test_pinned_spec_signatures():
+    """Back-compat pin (ISSUE 11 satellite): the geo/churn spec keys
+    must not shift a single RNG draw for any PRE-EXISTING spec — the
+    fault sequence of every committed scenario is part of the
+    replayability contract. These digests were recorded on the
+    pre-geo/churn code; if this test fails, a code change silently
+    rewrote every pinned seeded trajectory."""
+    import hashlib
+    from tendermint_tpu.chaos.runner import ACCEPTANCE_SPEC, SMOKE_SPEC
+
+    def drive_digest(spec, seed=11, n=400, nodes=4):
+        s = FaultSchedule(spec, seed=seed)
+        for step in range(n):
+            for src in range(nodes):
+                for dst in range(nodes):
+                    if src != dst:
+                        s.link_deliveries(step, src, dst, "vote")
+        return hashlib.sha256(repr(s.signature()).encode()).hexdigest()
+
+    rate_spec = {"drop": 0.1, "delay": 0.2, "duplicate": 0.05,
+                 "reorder": 0.05}
+    assert drive_digest(ACCEPTANCE_SPEC) == (
+        "e6ac7aee7d9e7877f8ec0d8003457ab3462c1000d0f707aec1c0b910148f6331")
+    assert drive_digest(SMOKE_SPEC) == (
+        "d2feacb993a35596ec39f6840ad1419d925165d7b8c307d8bb3d0bdbbadaad0c")
+    assert drive_digest(rate_spec) == (
+        "d3c4ea864a6572f7792871ed4639eb0e15792ccebb061cf3b494d52cd3fa70d6")
+
+
+def test_geo_profile_shapes_links_deterministically():
+    """Geo matrices: cross-region messages pick up the profile's
+    latency (+ seeded jitter), intra-region ones don't; losses and
+    throttles are seeded (same seed = same sequence) and recorded as
+    geo_* fault kinds; regions assign round-robin unless mapped."""
+    spec = {"geo": {"profile": "wan3"}, "drop": 0.02}
+
+    def drive(seed):
+        s = FaultSchedule(spec, seed=seed)
+        for step in range(300):
+            for src in range(6):
+                for dst in range(6):
+                    if src != dst:
+                        s.link_deliveries(step, src, dst, "vote")
+        return s
+
+    a, b = drive(5), drive(5)
+    assert a.signature() == b.signature()
+    assert a.counts.get("geo_drop", 0) > 0
+    assert drive(6).signature() != a.signature()
+
+    s = FaultSchedule({"geo": {"profile": "wan3"}})
+    assert [s.region_of(i) for i in range(6)] == [0, 1, 2, 0, 1, 2]
+    # region 0 -> 2 carries wan3's 5-step base latency (+ jitter);
+    # 0 -> 3 is intra-region region-0 traffic: free
+    assert min(s.link_deliveries(1, 0, 2, "vote")) >= 5
+    assert s.link_deliveries(1, 0, 3, "vote") == [0]
+    # explicit assignment overrides round-robin
+    s2 = FaultSchedule({"geo": {"profile": "wan2",
+                                "assign": {0: 1, 1: 1, 2: 0}}})
+    assert s2.region_of(0) == 1 and s2.region_of(2) == 0
+    assert s2.region_of(5) == 1  # unmapped: round-robin over 2 regions
+
+
+def test_geo_bandwidth_cap_spills_to_later_steps():
+    """A thin long-haul pipe queues, it does not destroy: messages
+    beyond the per-step cap on one region pair are DELAYED by their
+    queue position and recorded as geo_throttle."""
+    spec = {"geo": {"latency_steps": [[0, 1], [1, 0]],
+                    "jitter_steps": 0,
+                    "bandwidth_msgs": [[0, 3], [3, 0]]}}
+    s = FaultSchedule(spec, seed=1)
+    delays = [s.link_deliveries(7, 0, 1, "vote")[0] for _ in range(7)]
+    # first 3 ride the base latency; 4-6 spill 1 step; 7th spills 2
+    assert delays == [1, 1, 1, 2, 2, 2, 3]
+    assert s.counts.get("geo_throttle") == 4
+    # a new step resets the pipe
+    assert s.link_deliveries(8, 0, 1, "vote") == [1]
+
+
+def test_geo_and_churn_spec_validation():
+    with pytest.raises(ValueError, match="unknown geo profile"):
+        FaultSchedule({"geo": {"profile": "atlantis"}})
+    with pytest.raises(ValueError, match="unknown geo spec key"):
+        FaultSchedule({"geo": {"profile": "wan3", "latencey": 1}})
+    with pytest.raises(ValueError, match="must be 2x2"):
+        FaultSchedule({"geo": {"latency_steps": [[0, 1], [1]]}})
+    with pytest.raises(ValueError, match="unknown churn op"):
+        FaultSchedule({"churn": {"ops": ["jion"]}})
+    with pytest.raises(ValueError, match="unknown churn spec key"):
+        FaultSchedule({"churn": {"every": 3}})
+    c = FaultSchedule({"churn": {"standby": 2}}).churn
+    assert c["ops"] == ["join", "leave", "stake"]
+    assert c["every_heights"] == 2 and c["standby"] == 2
+
+
 def test_partition_and_skew_lookup():
     s = FaultSchedule({"partitions": [{"start": 10, "stop": 20,
                                        "groups": [[0], [1, 2]]}],

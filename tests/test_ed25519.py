@@ -167,6 +167,79 @@ def test_predecompressed_cache_path_matches_full():
         ed25519._predecomp_seen.clear()
 
 
+def test_predecomp_telemetry_stays_meaningful_under_churn():
+    """Valset rotation vs the per-pubkey predecompression LRU (ISSUE 11
+    satellite): a rotating valset must show up as full->fill->hit
+    cycles per rotation, evictions must be COUNTED (they were invisible
+    before — a churning valset quietly degraded every hit into a
+    re-fill), and the tm_verifier_predecomp_* counters must mirror the
+    host stats."""
+    from tendermint_tpu import telemetry
+    from tendermint_tpu.ops import ed25519
+    from tendermint_tpu.utils import ed25519_ref as ref
+
+    from bench_util import fast_signer
+
+    def batch(tag, n=8):
+        pubs, msgs, sigs = [], [], []
+        for i in range(n):
+            seed = bytes([tag, i]) * 16
+            m = b"churn %d.%d" % (tag, i)
+            pubs.append(ref.public_key(seed))
+            msgs.append(m)
+            sigs.append(fast_signer(seed)(m))
+        return pubs, msgs, sigs
+
+    was_enabled = telemetry.enabled()
+    telemetry.set_enabled(True)
+    ed25519._predecomp.clear()
+    ed25519._predecomp_seen.clear()
+    orig_min = ed25519._PREDECOMP_MIN_BATCH
+    orig_max = ed25519._PREDECOMP_MAX_KEYS
+    ed25519._PREDECOMP_MIN_BATCH = 8
+    ed25519._PREDECOMP_MAX_KEYS = 8  # one valset's worth of rows
+    s0 = ed25519.predecomp_stats()
+    ev0 = telemetry.value("verifier_predecomp_evictions_total") or 0.0
+    try:
+        a = batch(1)
+        for _ in range(3):  # full (first sighting) -> fill -> hit
+            assert ed25519.verify_batch(*a).all()
+        s1 = ed25519.predecomp_stats()
+        assert s1["full"] == s0["full"] + 1
+        assert s1["fill"] == s0["fill"] + 1
+        assert s1["hit"] == s0["hit"] + 1
+        assert s1["evict"] == s0["evict"]
+        assert s1["keys"] == 8
+
+        # rotation: a new valset's repeat traffic evicts the old rows
+        # (capacity 8) and runs its own full->fill->hit cycle — the
+        # hit/fill split stays meaningful instead of silently decaying
+        b = batch(2)
+        for _ in range(3):
+            assert ed25519.verify_batch(*b).all()
+        s2 = ed25519.predecomp_stats()
+        assert s2["full"] == s1["full"] + 1
+        assert s2["fill"] == s1["fill"] + 1
+        assert s2["hit"] == s1["hit"] + 1
+        assert s2["evict"] == s1["evict"] + 8  # old valset's rows
+        assert s2["keys"] == 8
+        assert 0.0 < s2["hit_rate"] < 1.0
+
+        # telemetry mirrors the host stats (the new eviction counter
+        # most of all — that is the one that was invisible)
+        assert (telemetry.value("verifier_predecomp_evictions_total")
+                - ev0) == 8.0
+        assert telemetry.value("verifier_predecomp_keys") == 8.0
+        assert telemetry.value("verifier_predecomp_batches_total",
+                               {"outcome": "hit"}) >= 2.0
+    finally:
+        telemetry.set_enabled(was_enabled)
+        ed25519._PREDECOMP_MIN_BATCH = orig_min
+        ed25519._PREDECOMP_MAX_KEYS = orig_max
+        ed25519._predecomp.clear()
+        ed25519._predecomp_seen.clear()
+
+
 def test_scalar_openssl_matches_pure_oracle():
     """PubKey.verify/verify_any route through OpenSSL (~170x faster);
     verdicts must agree with the pure RFC 8032 oracle on valid,

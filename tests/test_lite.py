@@ -6,6 +6,7 @@ import pytest
 
 from tendermint_tpu.lite import (
     CertificationError,
+    ContinuousCertifier,
     DynamicCertifier,
     FileProvider,
     FullCommit,
@@ -140,6 +141,100 @@ def test_inquiring_certifier_bisects():
     # bisection finds height 10 (vk2: 3/4 overlap), then 20, then 25
     cert.certify(vk3.sign_header(25))
     assert cert.last_height >= 20
+
+
+def _derive(vk, keys):
+    """ValKeys view over an explicit key list (churn helper)."""
+    out = ValKeysView(vk)
+    out.keys = keys
+    out.valset = ValidatorSet(
+        [Validator(k.pubkey.ed25519, 10) for k in keys])
+    return out
+
+
+def test_continuous_certifier_tracks_consecutive_deltas():
+    """ISSUE 11 satellite: sequential certification across >=3
+    consecutive valset deltas — join, leave, and power change, each
+    its own height — with unchanged heights certified statically in
+    between. The certifier must end trusting the final set, having
+    crossed every delta."""
+    vk1 = ValKeys(4)
+    extra = PrivKey.generate(b"\x41" * 32)
+    vk2 = _derive(vk1, vk1.keys + [extra])          # height 3: join
+    vk3 = ValKeysView(vk2)                          # height 4: stake
+    vk3.valset = ValidatorSet(
+        [Validator(k.pubkey.ed25519, 20 if i == 0 else 10)
+         for i, k in enumerate(vk2.keys)])
+    vk4 = _derive(vk3, vk2.keys[1:])                # height 5: leave
+
+    cert = ContinuousCertifier(CHAIN, vk1.valset)
+    chain = [(1, vk1), (2, vk1), (3, vk2), (4, vk3), (5, vk4), (6, vk4)]
+    for h, vk in chain:
+        cert.advance(vk.sign_header(h))
+    assert cert.certified_height == 6
+    assert cert.updates == 3
+    assert cert.static_certified == 3
+    assert cert.validators.hash() == vk4.valset.hash()
+    # stale or skipped heights are refused outright — continuity is
+    # the whole safety argument
+    with pytest.raises(CertificationError, match="expects height"):
+        cert.advance(vk4.sign_header(6))
+    with pytest.raises(CertificationError, match="expects height"):
+        cert.advance(vk4.sign_header(9))
+
+
+def test_continuous_certifier_quorum_sparse_commit_over_churn():
+    """The realistic case that breaks naive overlap counting: the
+    commit carries only a +2/3 QUORUM of signatures (not everyone),
+    at the height where a validator joined. Sequential certification
+    must still succeed — the signing set's own +2/3 plus >1/3 trusted
+    endorsement are both satisfiable from a sparse commit."""
+    vk1 = ValKeys(4)
+    extra = PrivKey.generate(b"\x42" * 32)
+    vk2 = _derive(vk1, vk1.keys + [extra])
+    cert = ContinuousCertifier(CHAIN, vk1.valset)
+    cert.advance(vk1.sign_header(1))
+    # 4 of 5 sign (40/50 > 2/3 of new set; all 4 are trusted members
+    # -> endorsement 40/40 > 1/3 of trusted power)
+    cert.advance(vk2.sign_header(2, last=4))
+    assert cert.updates == 1
+    assert cert.certified_height == 2
+
+
+def test_continuous_certifier_loud_on_large_power_move():
+    """Loud-failure coverage (ISSUE 11 satellite): transitions that
+    move too much power between trusted heights must raise, not
+    quietly adopt the new set.
+
+    (a) one delta replacing >2/3 of the trusted power: the trusted
+        set's endorsement among the signers falls to 1/3 or less ->
+        CertificationError from the continuous tracker;
+    (b) a JUMP between trusted heights where >1/3 of the power
+        changed: DynamicCertifier.update's strict v0.16 rule refuses
+        (old-set overlap needs >2/3), and the continuous tracker
+        refuses the jump outright."""
+    # (a) 3 of 4 equal-power validators replaced in one height
+    vk1 = ValKeys(4)
+    vk_swap = _derive(vk1, vk1.keys[:1]
+                      + [PrivKey.generate(bytes([0x50 + i]) * 32)
+                         for i in range(3)])
+    cert = ContinuousCertifier(CHAIN, vk1.valset)
+    cert.advance(vk1.sign_header(1))
+    with pytest.raises(CertificationError,
+                       match="insufficient trusted-set endorsement"):
+        cert.advance(vk_swap.sign_header(2))
+    # trust did NOT advance past the failed height
+    assert cert.certified_height == 1
+    assert cert.validators.hash() == vk1.valset.hash()
+
+    # (b) 2 of 4 rotated between height 1 and 10 (50% of power — more
+    # than 1/3): the jump bridge must refuse
+    vk_jump = _derive(vk1, vk1.keys[:2]
+                      + [PrivKey.generate(bytes([0x60 + i]) * 32)
+                         for i in range(2)])
+    dyn = DynamicCertifier(CHAIN, vk1.valset, height=1)
+    with pytest.raises(CertificationError):
+        dyn.update(vk_jump.sign_header(10))
 
 
 def test_providers_roundtrip(tmp_path):

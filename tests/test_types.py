@@ -178,6 +178,96 @@ def test_valset_updates():
         vs3.update_with_changes([Validator(newkey.pubkey.ed25519, 0)])  # unknown
 
 
+def test_valset_remove_to_single_validator():
+    """Churn edge (ISSUE 11 satellite): the set may legally shrink to
+    ONE validator (a solo chain is valid), but never to zero — the
+    delta that would empty it is rejected atomically (no partial
+    application: the surviving set is untouched)."""
+    vs, _ = make_valset(3)
+    a, b, c = [v.pubkey for v in vs.validators]
+    vs1 = vs.update_with_changes([Validator(a, 0), Validator(b, 0)])
+    assert len(vs1) == 1 and vs1.validators[0].pubkey == c
+    assert vs1.proposer().pubkey == c
+    vs1.increment_accum(5)  # rotation over a singleton must not blow up
+    assert vs1.proposer().pubkey == c
+    with pytest.raises(ValueError, match="empty"):
+        vs1.update_with_changes([Validator(c, 0)])
+    assert len(vs1) == 1  # rejection left the set intact
+
+
+def test_valset_rejects_delta_that_empties_set():
+    """One batch removing every member is refused even when each
+    individual removal names a known validator."""
+    vs, _ = make_valset(4)
+    with pytest.raises(ValueError, match="empty"):
+        vs.update_with_changes(
+            [Validator(v.pubkey, 0) for v in vs.validators])
+
+
+def test_valset_readd_of_removed_key_starts_fresh_accum():
+    """Leave then re-join of the same key: the re-added validator is a
+    NEW member — its proposer-priority accumulator restarts at 0
+    instead of resuming the stale pre-removal value (a resumed accum
+    would hand a rejoining validator an immediate, unearned proposer
+    slot or an unfair deficit)."""
+    vs, _ = make_valset(4)
+    target = vs.validators[0].pubkey
+    vs.increment_accum(7)  # build up non-trivial accums
+    removed = vs.update_with_changes([Validator(target, 0)])
+    assert not removed.has_address(vs.validators[0].address)
+    readded = removed.update_with_changes([Validator(target, 10)])
+    assert len(readded) == 4
+    _, val = readded.get_by_address(vs.validators[0].address)
+    assert val.accum == 0
+    # survivors carried their mid-rotation accums over (reference
+    # Add/Update/Remove semantics: _fresh=False, no re-increment)
+    for v in removed.validators:
+        _, after = readded.get_by_address(v.address)
+        assert after.accum == v.accum
+    assert readded.hash() == vs.hash()  # same membership+powers again
+
+
+def test_valset_proposer_fairness_across_join_leave_sequence():
+    """Proposer selection stays power-proportional THROUGH a
+    join/leave churn sequence: over a long window every member
+    proposes ~power/total of the rounds, including validators that
+    joined mid-sequence (a join/leave that skewed rotation would
+    starve or favor someone for many heights — the live-net symptom
+    is one validator proposing twice in a row or never)."""
+    vs, _ = make_valset(3)
+    joiner = PrivKey.generate(b"\x66" * 32).pubkey.ed25519
+    counts = {}
+    rounds_before, rounds_after = 30, 120
+    for _ in range(rounds_before):
+        vs.increment_accum(1)
+        counts[vs.proposer().pubkey] = \
+            counts.get(vs.proposer().pubkey, 0) + 1
+    vs = vs.update_with_changes([Validator(joiner, 10)])  # join
+    back_to_back = 0
+    last = None
+    counts_after = {}
+    for _ in range(rounds_after):
+        vs.increment_accum(1)
+        p = vs.proposer().pubkey
+        counts_after[p] = counts_after.get(p, 0) + 1
+        back_to_back += (p == last)
+        last = p
+    # 4 equal-power members over 120 rounds: exactly 30 each, and an
+    # equal-power set never hands anyone consecutive slots
+    assert sorted(counts_after.values()) == [30, 30, 30, 30]
+    assert joiner in counts_after
+    assert back_to_back == 0
+    # now a leave: remaining members re-converge to thirds
+    vs = vs.update_with_changes([Validator(joiner, 0)])
+    counts_final = {}
+    for _ in range(90):
+        vs.increment_accum(1)
+        p = vs.proposer().pubkey
+        counts_final[p] = counts_final.get(p, 0) + 1
+    assert joiner not in counts_final
+    assert sorted(counts_final.values()) == [30, 30, 30]
+
+
 # ---------------------------------------------------------- PrivValidator --
 
 def test_priv_validator_double_sign_protection(tmp_path):
