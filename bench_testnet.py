@@ -349,7 +349,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                duration_s: float = 25.0, burst: str = "",
                chaos: str = "", pipeline: str = "",
                parity: bool = False, trace: str = "",
-               profile: str = "") -> dict:
+               profile: str = "", reactor: str = "") -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -386,6 +386,10 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
     if profile:  # sampling profiler A/B for every node (bench.py
         #         --profile-json); "" inherits the caller env
         env["TM_TPU_PROF"] = profile
+    if reactor:  # async reactor core A/B (bench.py --p2p-json):
+        #         loop = one event loop per node, threads = the
+        #         per-connection thread plane; "" inherits caller env
+        env["TM_TPU_REACTOR"] = reactor
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -580,6 +584,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             "transport": "tcp sockets, 4 OS processes, secret conns",
             "burst": burst or "default",
             "pipeline": pipeline or "default",
+            "reactor": reactor or "default",
             "p2p": p2p_metrics,
             **({"pipeline_metrics": pipeline_metrics}
                if pipeline_metrics else {}),

@@ -83,6 +83,16 @@ EXTRACTORS = (
     ("chaos_lite_certified_height_32v", "BENCH_chaos.json",
      "scaling_curve[n_validators=32].lite.certified_height", "heights",
      "up"),
+    # the ISSUE-12 front door: WS subscriber capacity and subscribe
+    # latency under load in loop mode — the connection-capacity floor
+    # the >=10x-vs-threads acceptance rode in on, and the latency that
+    # must not quietly rot as the loop grows responsibilities
+    ("rpc_ws_subscribers_loop", "BENCH_rpc.json",
+     "loop.subscribed", "conns", "up"),
+    ("rpc_subscribe_ack_p99_ms_loop", "BENCH_rpc.json",
+     "loop.subscribe_ack_p99_ms", "ms", "down"),
+    ("rpc_subscriber_ratio_loop_vs_threads", "BENCH_rpc.json",
+     "subscriber_ratio_loop_vs_threads", "x", "up"),
     ("mesh_8dev_verifies_per_sec", "BENCH_mesh.json",
      "points[devices=8].verifies_per_sec", "verifies/sec", "up"),
     ("statesync_speedup_vs_replay", "BENCH_sync.json",

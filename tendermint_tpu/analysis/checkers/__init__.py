@@ -6,6 +6,9 @@ invoked separately by scripts/lint.py — `all_checkers()` returns only
 the AST ones so `analysis.run_tree` stays import-light.
 """
 
+from tendermint_tpu.analysis.checkers.asyncblock import (  # noqa: F401
+    AsyncBlockingChecker,
+)
 from tendermint_tpu.analysis.checkers.determinism import (  # noqa: F401
     DeterminismChecker,
 )
@@ -22,4 +25,5 @@ from tendermint_tpu.analysis.checkers.locks import (  # noqa: F401
 
 def all_checkers():
     return [DeterminismChecker(), LockDisciplineChecker(),
-            KnobRegistryChecker(), ExceptionHygieneChecker()]
+            KnobRegistryChecker(), ExceptionHygieneChecker(),
+            AsyncBlockingChecker()]

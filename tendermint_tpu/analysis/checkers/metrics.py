@@ -30,7 +30,7 @@ KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
-    "snapshot", "sync", "prune", "prof", "queue",
+    "snapshot", "sync", "prune", "prof", "queue", "loop",
 }
 
 INSTRUMENTED_MODULES = [
@@ -54,6 +54,8 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.statesync.reactor",  # tm_sync_* chunk/restore plane
     "tendermint_tpu.telemetry.profile",  # tm_prof_* sampling profiler
     "tendermint_tpu.telemetry.queues",   # tm_queue_* backpressure plane
+    "tendermint_tpu.p2p.conn.loop",      # tm_loop_* reactor-loop core
+    "tendermint_tpu.rpc.aserver",        # tm_rpc_* async front door
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

@@ -188,6 +188,22 @@ class ChaosNet:
         self._frontier = 0
         self._frontier_step = 0
         self._last_stall_assist = 0
+        # gossip dedup (ISSUE 12 satellite): per-destination digests of
+        # byte-identical vote/part messages this NODE INCARNATION has
+        # provably consumed — re-delivering them (duplicate faults,
+        # catch-up/stall assists replaying whole per-height archives)
+        # is a no-op in the state machine but dominated the relay's
+        # O(n^2) per-step delivery cost at 128 validators. A message is
+        # only marked consumed when the machine could actually use it
+        # (votes: height <= rs.height at submit; parts: decided height
+        # or visibly present in the part set), so assists still
+        # re-deliver anything that was dropped or arrived early. Sets
+        # clear on crash — a rebuilt node lost its in-memory state.
+        # Decisions read only deterministic state and never touch the
+        # RNG: the fault log stays byte-identical.
+        self._delivered: List[set] = [set() for _ in range(n)]
+        self._digest_memo: Dict[int, tuple] = {}
+        self.dedup_skips = 0
         self.nodes: List[Optional[object]] = [None] * n
         self._t0 = time.perf_counter()
         for i in range(n):
@@ -337,6 +353,7 @@ class ChaosNet:
         node = self.nodes[i]
         self.nodes[i] = None
         self.monitor.detach(i)
+        self._delivered[i] = set()   # the rebuilt node starts blank
         crash["crash_step"] = self.t
         crash["restart_step"] = self.t + crash["down_steps"]
         self.schedule.record("crash", self.t, node=i,
@@ -538,14 +555,65 @@ class ChaosNet:
                 self._due.setdefault(t, []).append(item)
         self._part_buf = keep
 
+    def _msg_digest(self, m: dict) -> bytes:
+        """Canonical digest of a relayed message, memoized by object
+        identity (one message object fans out to n-1 destinations and
+        through every assist replay; the archive pins the object alive,
+        so the id key stays valid — the memo holds a reference too)."""
+        key = id(m)
+        hit = self._digest_memo.get(key)
+        if hit is not None and hit[0] is m:
+            return hit[1]
+        import hashlib
+        import json as _json
+        d = hashlib.sha256(_json.dumps(
+            m, sort_keys=True, default=str).encode()).digest()
+        self._digest_memo[key] = (m, d)
+        return d
+
+    def _deliver_one(self, dst: int, peer_label: str, m: dict) -> None:
+        """Deliver one relayed message to `dst` with gossip dedup:
+        byte-identical vote/part messages the destination's CURRENT
+        incarnation already consumed are skipped (provable no-ops)."""
+        node = self.nodes[dst]
+        if node is None:
+            return  # the wire to a dead node drops everything
+        t = m.get("type")
+        digest = None
+        if t in ("vote", "block_part"):
+            digest = self._msg_digest(m)
+            if digest in self._delivered[dst]:
+                self.dedup_skips += 1
+                return
+        self._interact(dst, lambda n=node, mm=m, s=peer_label:
+                       n.consensus.submit(dict(mm), peer_id=s))
+        if digest is None:
+            return
+        node = self.nodes[dst]   # the submit may have crashed the node
+        if node is None:
+            return
+        rs = node.consensus.rs
+        h = _msg_height(m)
+        if t == "vote":
+            # consumable heights were consumed; past heights are
+            # dropped forever — either way a re-delivery adds nothing
+            if h <= rs.height:
+                self._delivered[dst].add(digest)
+        elif t == "block_part":
+            if h < rs.height:
+                self._delivered[dst].add(digest)   # decided: useless now
+            elif h == rs.height and rs.proposal_block_parts is not None:
+                try:
+                    idx = m["part"]["index"]
+                except (KeyError, TypeError):
+                    return
+                if rs.proposal_block_parts.get_part(idx) is not None:
+                    self._delivered[dst].add(digest)
+
     def _deliver_due(self) -> None:
         batch = sorted(self._due.pop(self.t, []))
         for _, src, dst, m in batch:
-            node = self.nodes[dst]
-            if node is None:
-                continue  # the wire to a dead node drops everything
-            self._interact(dst, lambda n=node, mm=m, s=src: n.consensus.
-                           submit(dict(mm), peer_id=f"node{s}"))
+            self._deliver_one(dst, f"node{src}", m)
 
     def _assist(self) -> None:
         """Reactor-style catch-up for nodes behind the committed
@@ -577,10 +645,7 @@ class ChaosNet:
                     for src, m in ordered:
                         if src == i:
                             continue
-                        self._interact(
-                            i, lambda n=node, mm=m, s=src:
-                            n.consensus.submit(dict(mm),
-                                               peer_id=f"stall{s}"))
+                        self._deliver_one(i, f"stall{src}", m)
         for i, node in enumerate(self.nodes):
             if node is None or self._height(i) >= frontier:
                 continue
@@ -598,8 +663,7 @@ class ChaosNet:
             for src, m in ordered:
                 if src == i:
                     continue
-                self._interact(i, lambda n=node, mm=m, s=src: n.consensus.
-                               submit(dict(mm), peer_id=f"assist{s}"))
+                self._deliver_one(i, f"assist{src}", m)
 
     # ----------------------------------------------------------------- driving
 
@@ -654,6 +718,7 @@ class ChaosNet:
         rep["faults_injected"] = dict(self.schedule.counts)
         rep["faults_injected_total"] = sum(self.schedule.counts.values())
         rep["catchup_assists"] = self.assists
+        rep["relay_dedup_skips"] = self.dedup_skips
         rep["n_nodes"] = self.n
         rep["n_genesis_validators"] = self.n_genesis_validators
         rep["blocks_per_sec"] = round(rep["max_height"] / wall, 3) \

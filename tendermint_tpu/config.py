@@ -90,6 +90,12 @@ class BaseConfig:
     prof: str = "off"
     prof_hz: float = 0.0  # 0 = profile.DEFAULT_HZ (13)
     queue_watch: str = "on"
+    # async reactor core (p2p/conn/loop.py): "loop" (= auto, the
+    # default) runs every peer socket, gossip routine and RPC/WebSocket
+    # connection on ONE selector event loop per node; "threads"
+    # restores the thread-per-connection plane byte-for-byte (the
+    # wire-parity / chaos-replay escape hatch). TM_TPU_REACTOR wins.
+    reactor: str = "auto"
 
 
 @dataclass

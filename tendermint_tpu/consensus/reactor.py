@@ -40,6 +40,22 @@ GOSSIP_SLEEP_S = 0.1
 # missed edge.
 
 
+class _GossipWake(threading.Event):
+    """A threading.Event that ALSO notifies registered listeners on
+    set() — the loop-mode gossip tasks park on the loop, not on the
+    event, so a wake must reach them through their thread-safe
+    ``Task.wake`` (listeners). Thread-mode behavior is untouched."""
+
+    def __init__(self):
+        super().__init__()
+        self.listeners: list = []
+
+    def set(self) -> None:
+        super().set()
+        for cb in list(self.listeners):
+            cb()
+
+
 class PeerRoundState:
     """What we know the peer knows (consensus/reactor.go:828 PeerState)."""
 
@@ -59,8 +75,9 @@ class PeerRoundState:
         # own state gains something sendable OR the peer's state
         # changes; the gossip loops park on it instead of polling
         # (the reference polls at 100 ms — on a shared-core testnet the
-        # per-iteration Python cost made that ~26% of each node's CPU)
-        self.wake = threading.Event()
+        # per-iteration Python cost made that ~26% of each node's CPU).
+        # In loop mode the same signal wakes the cooperative tasks.
+        self.wake = _GossipWake()
 
     def apply_new_round_step(self, msg: dict) -> None:
         with self.lock:
@@ -210,6 +227,47 @@ class ConsensusReactor(Reactor):
         # (consensus/reactor.go AddPeer gates on conR.FastSync())
         if not self.fast_sync:
             peer.try_send_obj(STATE_CHANNEL, self._our_round_step_msg())
+        loop = getattr(self.switch, "loop", None) \
+            if self.switch is not None else None
+        if loop is not None:
+            # async reactor core: gossip as cooperative tasks on the
+            # node's event loop. Same pass bodies, same 100ms idle
+            # backstop, woken by the same _GossipWake edges — plus the
+            # conn's drain wake, which replaces the blocking send the
+            # thread routines relied on for backpressure.
+            st = {"idle": 0}
+
+            def data_task():
+                if not self._peer_alive(peer):
+                    return "stop"
+                if self.fast_sync:
+                    return self.gossip_sleep_s
+                ps.wake.clear()
+                return 0.0 if self._gossip_data_pass(peer, ps) \
+                    else self.gossip_sleep_s
+
+            def votes_task():
+                if not self._peer_alive(peer):
+                    return "stop"
+                if self.fast_sync:
+                    return self.gossip_sleep_s
+                ps.wake.clear()
+                return 0.0 if self._gossip_votes_pass(peer, ps, st) \
+                    else self.gossip_sleep_s
+
+            tasks = [
+                loop.spawn(data_task, owner="consensus",
+                           name=f"gossip-data-{peer.id[:8]}"),
+                loop.spawn(votes_task, owner="consensus",
+                           name=f"gossip-votes-{peer.id[:8]}"),
+            ]
+            for t in tasks:
+                ps.wake.listeners.append(t.wake)
+            for t in tasks:
+                getattr(peer.mconn, "drain_listeners", []).append(t.wake)
+            with self._lock:
+                self._peer_threads[peer.id] = tasks
+            return
         threads = []
         for fn, name in ((self._gossip_data_routine, "data"),
                          (self._gossip_votes_routine, "votes")):
@@ -223,7 +281,13 @@ class ConsensusReactor(Reactor):
     def remove_peer(self, peer, reason) -> None:
         with self._lock:
             self.peer_states.pop(peer.id, None)
-            self._peer_threads.pop(peer.id, None)
+            entries = self._peer_threads.pop(peer.id, None)
+        # loop-mode gossip tasks would otherwise stay parked forever
+        # (no wake reaches a removed peer); threads exit via _peer_alive
+        for t in entries or ():
+            stop = getattr(t, "stop", None)
+            if stop is not None and not isinstance(t, threading.Thread):
+                stop()
 
     def _our_round_step_msg(self) -> dict:
         rs = self.cs.rs
@@ -437,164 +501,180 @@ class ConsensusReactor(Reactor):
                 peer.id in self.peer_states)
 
     def _gossip_data_routine(self, peer, ps: PeerRoundState) -> None:
-        """consensus/reactor.go:466 gossipDataRoutine."""
+        """consensus/reactor.go:466 gossipDataRoutine (thread mode; the
+        loop mode runs _gossip_data_pass as a cooperative task)."""
         while self._peer_alive(peer):
             if self.fast_sync:
                 ps.wake.wait(self.gossip_sleep_s)
                 ps.wake.clear()
                 continue
-            sent = False
-            catchup_height = 0
-            with self.cs._lock:
-                rs = self.cs.rs
-                p_height, p_round, _, p_has_proposal, p_parts, _ = \
-                    ps.snapshot()
-                proposal_msg = None
-                part_msg = None
-                if rs.height == p_height:
-                    # 1) the proposal itself
-                    if rs.proposal is not None and not p_has_proposal and \
-                            rs.proposal.round == p_round:
-                        proposal_msg = {"type": "proposal",
-                                        "proposal": rs.proposal.to_obj()}
-                    # 2) block parts the peer lacks
-                    elif rs.proposal_block_parts is not None:
-                        parts = rs.proposal_block_parts
-                        for i in range(parts.total):
-                            if i not in p_parts and \
-                                    parts.get_part(i) is not None:
-                                part_msg = {
-                                    "type": "block_part",
-                                    "height": rs.height, "round": rs.round,
-                                    "part": parts.get_part(i).to_obj()}
-                                break
-                elif 0 < p_height < rs.height:
-                    catchup_height = p_height
-            if catchup_height:
-                # catchup: serve parts of the block they're finishing —
-                # store reads stay OUTSIDE the state machine's lock (the
-                # store is independently thread-safe; holding cs._lock
-                # across db I/O would stall vote/proposal processing)
-                meta = self.cs.block_store.load_block_meta(catchup_height)
-                if meta is not None:
-                    for i in range(meta.block_id.parts.total):
-                        if i not in p_parts:
-                            part = self.cs.block_store.load_block_part(
-                                catchup_height, i)
-                            if part is None:
-                                break
-                            part_msg = {
-                                "type": "block_part",
-                                "height": catchup_height, "round": -1,
-                                "part": part.to_obj()}
-                            break
-            if proposal_msg is not None:
-                p = proposal_msg["proposal"]
-                causal.stamp(proposal_msg, p["height"], p["round"])
-                if peer.send(DATA_CHANNEL, encoding.cdumps(proposal_msg)):
-                    ps.set_has_proposal(
-                        proposal_msg["proposal"]["block_parts_header"]
-                        ["total"])
-                    sent = True
-            elif part_msg is not None:
-                causal.stamp(part_msg, part_msg["height"],
-                             part_msg["round"])
-                if peer.send(DATA_CHANNEL, encoding.cdumps(part_msg)):
-                    ps.set_has_part(part_msg["part"]["index"])
-                    sent = True
-            if not sent:
+            if not self._gossip_data_pass(peer, ps):
                 # park until something changes (local state or peer
                 # state), with the reference's 100 ms idle backstop
                 # (consensus/reactor.go peerGossipSleepDuration)
                 ps.wake.wait(self.gossip_sleep_s)
                 ps.wake.clear()
 
+    def _gossip_data_pass(self, peer, ps: PeerRoundState) -> bool:
+        """One pass of the data-gossip body: send at most one proposal
+        or block part the peer provably lacks. True when sent."""
+        sent = False
+        catchup_height = 0
+        with self.cs._lock:
+            rs = self.cs.rs
+            p_height, p_round, _, p_has_proposal, p_parts, _ = \
+                ps.snapshot()
+            proposal_msg = None
+            part_msg = None
+            if rs.height == p_height:
+                # 1) the proposal itself
+                if rs.proposal is not None and not p_has_proposal and \
+                        rs.proposal.round == p_round:
+                    proposal_msg = {"type": "proposal",
+                                    "proposal": rs.proposal.to_obj()}
+                # 2) block parts the peer lacks
+                elif rs.proposal_block_parts is not None:
+                    parts = rs.proposal_block_parts
+                    for i in range(parts.total):
+                        if i not in p_parts and \
+                                parts.get_part(i) is not None:
+                            part_msg = {
+                                "type": "block_part",
+                                "height": rs.height, "round": rs.round,
+                                "part": parts.get_part(i).to_obj()}
+                            break
+            elif 0 < p_height < rs.height:
+                catchup_height = p_height
+        if catchup_height:
+            # catchup: serve parts of the block they're finishing —
+            # store reads stay OUTSIDE the state machine's lock (the
+            # store is independently thread-safe; holding cs._lock
+            # across db I/O would stall vote/proposal processing)
+            meta = self.cs.block_store.load_block_meta(catchup_height)
+            if meta is not None:
+                for i in range(meta.block_id.parts.total):
+                    if i not in p_parts:
+                        part = self.cs.block_store.load_block_part(
+                            catchup_height, i)
+                        if part is None:
+                            break
+                        part_msg = {
+                            "type": "block_part",
+                            "height": catchup_height, "round": -1,
+                            "part": part.to_obj()}
+                        break
+        if proposal_msg is not None:
+            p = proposal_msg["proposal"]
+            causal.stamp(proposal_msg, p["height"], p["round"])
+            if peer.send(DATA_CHANNEL, encoding.cdumps(proposal_msg)):
+                ps.set_has_proposal(
+                    proposal_msg["proposal"]["block_parts_header"]
+                    ["total"])
+                sent = True
+        elif part_msg is not None:
+            causal.stamp(part_msg, part_msg["height"],
+                         part_msg["round"])
+            if peer.send(DATA_CHANNEL, encoding.cdumps(part_msg)):
+                ps.set_has_part(part_msg["part"]["index"])
+                sent = True
+        return sent
+
     # -------------------------------------------------------- gossip: votes
 
     def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
-        """consensus/reactor.go:604 gossipVotesRoutine."""
-        catchup_idle = 0   # iterations a catchup peer sat with nothing
-        #                    sendable — triggers the mark self-heal
+        """consensus/reactor.go:604 gossipVotesRoutine (thread mode;
+        loop mode runs _gossip_votes_pass as a cooperative task)."""
+        st = {"idle": 0}   # iterations a peer sat with nothing sendable
+        #                    — triggers the mark/announce self-heal
         while self._peer_alive(peer):
             if self.fast_sync:
                 ps.wake.wait(self.gossip_sleep_s)
                 ps.wake.clear()
                 continue
-            vote_msg = None
-            catchup_height = 0
-            with self.cs._lock:
-                rs = self.cs.rs
-                p_height, p_round, p_step, *_ , p_last_commit_round = \
-                    (*ps.snapshot(),)
-                if p_height == rs.height and rs.votes is not None:
+            if not self._gossip_votes_pass(peer, ps, st):
+                ps.wake.wait(self.gossip_sleep_s)
+                ps.wake.clear()
+
+    def _gossip_votes_pass(self, peer, ps: PeerRoundState,
+                           st: dict) -> bool:
+        """One pass of the vote-gossip body: send at most one vote the
+        peer provably lacks; after ~2s of consecutive idle passes run
+        the self-heal (forget catchup marks / re-announce round step).
+        True when a vote was sent."""
+        vote_msg = None
+        catchup_height = 0
+        with self.cs._lock:
+            rs = self.cs.rs
+            p_height, p_round, p_step, *_ , p_last_commit_round = \
+                (*ps.snapshot(),)
+            if p_height == rs.height and rs.votes is not None:
+                vote_msg = self._pick_vote_for(
+                    ps, rs.votes.prevotes(p_round), rs.height, p_round,
+                    VoteType.PREVOTE) or self._pick_vote_for(
+                    ps, rs.votes.precommits(p_round), rs.height,
+                    p_round, VoteType.PRECOMMIT)
+                if vote_msg is None and p_round >= 0 and \
+                        p_round != rs.round:
+                    # also our current round's votes (peer may be behind)
                     vote_msg = self._pick_vote_for(
-                        ps, rs.votes.prevotes(p_round), rs.height, p_round,
-                        VoteType.PREVOTE) or self._pick_vote_for(
-                        ps, rs.votes.precommits(p_round), rs.height,
-                        p_round, VoteType.PRECOMMIT)
-                    if vote_msg is None and p_round >= 0 and \
-                            p_round != rs.round:
-                        # also our current round's votes (peer may be behind)
-                        vote_msg = self._pick_vote_for(
-                            ps, rs.votes.prevotes(rs.round), rs.height,
-                            rs.round, VoteType.PREVOTE) or \
-                            self._pick_vote_for(
-                                ps, rs.votes.precommits(rs.round),
-                                rs.height, rs.round, VoteType.PRECOMMIT)
-                elif p_height + 1 == rs.height and rs.last_commit is not None:
-                    # peer finishing our previous height: last-commit votes
-                    vote_msg = self._pick_vote_for(
-                        ps, rs.last_commit, p_height, rs.last_commit.round,
-                        VoteType.PRECOMMIT)
-                elif 0 < p_height < rs.height:
-                    catchup_height = p_height
-            if vote_msg is None and catchup_height:
-                # deep catchup: precommits from the stored seen commit —
-                # db read outside the state machine's lock
-                commit = self.cs.block_store.load_seen_commit(catchup_height)
-                if commit is not None:
-                    known = ps.known_votes(catchup_height, commit.round(),
-                                           VoteType.PRECOMMIT)
-                    for i, pc in enumerate(commit.precommits):
-                        if pc is not None and i not in known:
-                            vote_msg = {"type": "vote",
-                                        "vote": pc.to_obj()}
-                            break
-            if vote_msg is not None:
-                vv = vote_msg["vote"]
-                causal.stamp(vote_msg, vv["height"], vv["round"])
-                if peer.send(VOTE_CHANNEL, encoding.cdumps(vote_msg)):
-                    v = vote_msg["vote"]
-                    ps.set_has_vote(v["height"], v["round"], v["type"],
-                                    v["validator_index"])
-                catchup_idle = 0
-                continue
-            # nothing sendable this pass: after ~2s of consecutive
-            # idling, self-heal. Two shapes, one threshold:
-            # - catchup peer: our marks may predate its fast-sync
-            #   handoff (votes we "sent" were dropped unprocessed) —
-            #   forget the height's marks and resend (PR 9).
-            # - otherwise: re-announce our NewRoundStep. The add_peer
-            #   announcement is a try_send into a just-built conn and
-            #   the receive side drops messages arriving before its
-            #   peer state registers, so either end of the connect
-            #   race can eat it — leaving the PEER's view of us blank
-            #   at (0, -1) while our view of it looks fine. The side
-            #   with the stale view cannot know it; the side with
-            #   NOTHING TO SEND re-announcing is what breaks the
-            #   genesis wedge (both halves idle forever otherwise).
-            #   Idempotent, one ~60-byte STATE message per idle peer
-            #   per threshold.
-            catchup_idle += 1
-            if catchup_idle * self.gossip_sleep_s >= 2.0:
-                catchup_idle = 0
-                if catchup_height:
-                    ps.forget_height(catchup_height)
-                    continue
-                peer.try_send_obj(STATE_CHANNEL,
-                                  self._our_round_step_msg())
-            ps.wake.wait(self.gossip_sleep_s)
-            ps.wake.clear()
+                        ps, rs.votes.prevotes(rs.round), rs.height,
+                        rs.round, VoteType.PREVOTE) or \
+                        self._pick_vote_for(
+                            ps, rs.votes.precommits(rs.round),
+                            rs.height, rs.round, VoteType.PRECOMMIT)
+            elif p_height + 1 == rs.height and rs.last_commit is not None:
+                # peer finishing our previous height: last-commit votes
+                vote_msg = self._pick_vote_for(
+                    ps, rs.last_commit, p_height, rs.last_commit.round,
+                    VoteType.PRECOMMIT)
+            elif 0 < p_height < rs.height:
+                catchup_height = p_height
+        if vote_msg is None and catchup_height:
+            # deep catchup: precommits from the stored seen commit —
+            # db read outside the state machine's lock
+            commit = self.cs.block_store.load_seen_commit(catchup_height)
+            if commit is not None:
+                known = ps.known_votes(catchup_height, commit.round(),
+                                       VoteType.PRECOMMIT)
+                for i, pc in enumerate(commit.precommits):
+                    if pc is not None and i not in known:
+                        vote_msg = {"type": "vote",
+                                    "vote": pc.to_obj()}
+                        break
+        if vote_msg is not None:
+            vv = vote_msg["vote"]
+            causal.stamp(vote_msg, vv["height"], vv["round"])
+            if peer.send(VOTE_CHANNEL, encoding.cdumps(vote_msg)):
+                v = vote_msg["vote"]
+                ps.set_has_vote(v["height"], v["round"], v["type"],
+                                v["validator_index"])
+            st["idle"] = 0
+            return True
+        # nothing sendable this pass: after ~2s of consecutive
+        # idling, self-heal. Two shapes, one threshold:
+        # - catchup peer: our marks may predate its fast-sync
+        #   handoff (votes we "sent" were dropped unprocessed) —
+        #   forget the height's marks and resend (PR 9).
+        # - otherwise: re-announce our NewRoundStep. The add_peer
+        #   announcement is a try_send into a just-built conn and
+        #   the receive side drops messages arriving before its
+        #   peer state registers, so either end of the connect
+        #   race can eat it — leaving the PEER's view of us blank
+        #   at (0, -1) while our view of it looks fine. The side
+        #   with the stale view cannot know it; the side with
+        #   NOTHING TO SEND re-announcing is what breaks the
+        #   genesis wedge (both halves idle forever otherwise).
+        #   Idempotent, one ~60-byte STATE message per idle peer
+        #   per threshold.
+        st["idle"] += 1
+        if st["idle"] * self.gossip_sleep_s >= 2.0:
+            st["idle"] = 0
+            if catchup_height:
+                ps.forget_height(catchup_height)
+                return True  # marks reset: rescan immediately
+            peer.try_send_obj(STATE_CHANNEL,
+                              self._our_round_step_msg())
+        return False
 
     def _pick_vote_for(self, ps: PeerRoundState, vote_set, height: int,
                        round_: int, type_: int) -> Optional[dict]:

@@ -39,6 +39,18 @@ class MempoolReactor(Reactor):
     def add_peer(self, peer) -> None:
         if not self.broadcast:
             return
+        loop = getattr(self.switch, "loop", None) \
+            if self.switch is not None else None
+        if loop is not None:
+            # async reactor core: the per-peer broadcast walk runs as a
+            # cooperative task — same clist traversal and batching, the
+            # blocking waits replaced by short reschedules
+            st = {"el": None, "sent": set()}
+            task = loop.spawn(
+                lambda: self._broadcast_pass(peer, st),
+                owner="mempool", name=f"mempool-bcast-{peer.id[:8]}")
+            self._peer_threads[peer.id] = task
+            return
         t = threading.Thread(target=self._broadcast_tx_routine,
                              args=(peer,), daemon=True,
                              name=f"mempool-bcast-{peer.id[:8]}")
@@ -46,7 +58,9 @@ class MempoolReactor(Reactor):
         self._peer_threads[peer.id] = t
 
     def remove_peer(self, peer, reason) -> None:
-        self._peer_threads.pop(peer.id, None)
+        entry = self._peer_threads.pop(peer.id, None)
+        if entry is not None and not isinstance(entry, threading.Thread):
+            entry.stop()   # loop task: nothing wakes a removed peer's
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         msg = encoding.cloads(msg_bytes)
@@ -161,3 +175,61 @@ class MempoolReactor(Reactor):
                     time.sleep(_COALESCE_S)
             elif el.removed:
                 el = None  # tip removed: restart from the live front
+
+    _GOSSIP_BATCH = 64
+
+    def _broadcast_pass(self, peer, st: dict) -> object:
+        """One cooperative pass of the broadcast walk (loop mode): same
+        batch collection as _broadcast_tx_routine, returning the next
+        reschedule delay instead of blocking in clist waits. `st`
+        carries the cursor (`el`) and the sent-counter set."""
+        if self._stopped or not peer.running:
+            return "stop"
+        el = st["el"]
+        sent = st["sent"]
+        if el is None or el.removed:
+            el = self.mempool.txs.front()
+            if el is None:
+                sent.clear()   # mempool drained: forget history
+                st["el"] = None
+                return 0.1
+            st["el"] = el
+        batch: list = []
+        batch_counters: list = []
+        last = el
+        cur = el
+        catchup = False
+        peer_h = self._peer_height(peer)
+        while cur is not None and len(batch) < self._GOSSIP_BATCH:
+            mtx = cur.value
+            if mtx.counter not in sent and not cur.removed:
+                if peer_h >= 0 and peer_h < mtx.height - 1:
+                    catchup = True
+                    break
+                batch.append(mtx.tx.hex())
+                batch_counters.append(mtx.counter)
+            last = cur
+            cur = cur.next()
+        if catchup and not batch:
+            return PEER_CATCHUP_SLEEP_S
+        if batch:
+            msg = ({"type": "tx", "tx": batch[0]} if len(batch) == 1
+                   else {"type": "txs", "txs": batch})
+            causal.stamp(msg, el.value.height)
+            if not peer.send(MEMPOOL_CHANNEL, encoding.cdumps(msg)):
+                # channel queue full (backpressure) or conn stopping:
+                # fair stall, retry after the catchup interval
+                return PEER_CATCHUP_SLEEP_S
+            sent.update(batch_counters)
+            if len(sent) > 200_000:
+                sent.clear()
+        st["el"] = last
+        nxt = last.next()
+        if nxt is not None:
+            st["el"] = nxt
+            # trickle pacing as in the thread routine: a full batch
+            # means backlog draining — no pause
+            return 0.0 if len(batch) >= self._GOSSIP_BATCH else 0.02
+        if last.removed:
+            st["el"] = None
+        return 0.05   # parked at the tip: poll for the next insertion

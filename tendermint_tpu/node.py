@@ -71,6 +71,14 @@ class Node:
         from tendermint_tpu import pipeline as _pipeline
         _pipeline.configure(mode=getattr(config.base, "pipeline", "auto"))
 
+        # async reactor core (env TM_TPU_REACTOR wins inside resolve();
+        # "threads" restores the per-connection thread plane exactly).
+        # The ReactorLoop itself is created lazily below, only when a
+        # p2p switch or RPC listener actually needs one.
+        from tendermint_tpu.p2p.conn import loop as _loop_cfg
+        _loop_cfg.configure(mode=getattr(config.base, "reactor", "auto"))
+        self.loop = None
+
         # causal tracing plane (env TM_TPU_TRACE wins inside enabled();
         # off = untraced wire bytes + zero span recording). The node id
         # is refined to the p2p identity in _build_p2p.
@@ -266,6 +274,17 @@ class Node:
         self.indexer_service = IndexerService(self.tx_indexer,
                                               self.event_bus)
 
+    def _ensure_loop(self):
+        """The node's ONE event loop (async reactor core) when the
+        TM_TPU_REACTOR mode resolves to 'loop'; None in thread mode.
+        Shared by the p2p switch AND the RPC listener — one selector
+        owns every socket of the node."""
+        from tendermint_tpu.p2p.conn import loop as _loop_cfg
+        if self.loop is None and _loop_cfg.resolve() == "loop":
+            self.loop = _loop_cfg.ReactorLoop(
+                name=f"tm-reactor-loop-{os.getpid()}")
+        return self.loop
+
     def _build_p2p(self, state, fast_sync: bool, in_memory: bool) -> None:
         """node/node.go:235-265: switch + reactors (+PEX)."""
         from tendermint_tpu.blockchain import BlockchainReactor
@@ -285,7 +304,8 @@ class Node:
             pubkey=node_key.pubkey,
             moniker=getattr(self.config.base, "moniker", "node"),
             network=self.gen_doc.chain_id)
-        self.switch = Switch(self.config.p2p, node_key, node_info)
+        self.switch = Switch(self.config.p2p, node_key, node_info,
+                             loop=self._ensure_loop())
 
         # the p2p identity IS the node label everywhere observability
         # correlates: the causal trace plane (wire stamps + dumps), the
@@ -388,6 +408,9 @@ class Node:
                 # without replay, same as before) but must be visible
                 self.logger.error("WAL catchup replay skipped", err=str(e))
 
+        if self.loop is not None:
+            self.loop.start()
+
         if self.switch is not None:
             host, port = _parse_laddr(self.config.p2p.laddr)
             self.switch.listen(host, port)
@@ -427,7 +450,14 @@ class Node:
         # full JSON-RPC surface on the config-default 0.0.0.0 address)
         if self.with_rpc or self.config.rpc.grpc_laddr:
             from tendermint_tpu.rpc import RPCEnv, make_server
-            self.rpc_server, core = make_server(RPCEnv.from_node(self))
+            # loop mode: the RPC/WebSocket listener runs on the SAME
+            # event loop as the p2p plane (rpc/aserver.py) — no thread
+            # per connection; thread mode keeps the ThreadingHTTPServer
+            rpc_loop = self._ensure_loop() if self.with_rpc else None
+            if rpc_loop is not None and not rpc_loop.running:
+                rpc_loop.start()
+            self.rpc_server, core = make_server(RPCEnv.from_node(self),
+                                                loop=rpc_loop)
             if self.with_rpc:
                 host, port = _parse_laddr(self.config.rpc.laddr)
                 self.rpc_address = self.rpc_server.serve(host, port)
@@ -523,6 +553,9 @@ class Node:
                 self.trust_store.save()
         else:
             self.consensus.stop()
+        if self.loop is not None:
+            # after the switch: peer teardowns run ON the loop
+            self.loop.stop()
         if hasattr(self.mempool, "close"):
             self.mempool.close()
         self.app_conns.close()

@@ -423,6 +423,27 @@ class PlainFramedConn:
         with self._rlock:
             return self._read_frames_locked(limit=0)
 
+    def seal_frames(self, chunks) -> bytes:
+        """Loop-reactor codec surface: wire bytes for `chunks` (one
+        length-prefixed frame each) without touching the socket —
+        byte-identical to what write_many sends."""
+        return b"".join(struct.pack(">I", len(c)) + c for c in chunks)
+
+    def feed_wire(self, data: bytes):
+        """Loop-reactor codec surface: buffer raw bytes, return every
+        complete frame; partial frames stay buffered."""
+        with self._rlock:
+            if data:
+                self._rbuf += data
+            frames = []
+            while len(self._rbuf) >= 4:
+                (n,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+                if len(self._rbuf) < 4 + n:
+                    break
+                frames.append(bytes(self._rbuf[4:4 + n]))
+                del self._rbuf[:4 + n]
+            return frames
+
     def _fill_locked(self, need: int, allow_eof: bool = False) -> bool:
         while len(self._rbuf) < need:
             chunk = self.conn.recv(65536)

@@ -230,14 +230,16 @@ class SecretConnection:
                         struct.pack(">I", len(sealed)) + sealed)
         return total
 
-    def _seal_and_send_locked(self, chunks: List[bytes]) -> None:
+    def _seal_wire_locked(self, chunks: List[bytes]) -> bytes:
+        """Wire bytes for `chunks`, one frame each — exactly what
+        write_many would sendall (caller holds _send_lock)."""
         t0 = time.perf_counter() if telemetry.enabled() else 0.0
         wire = native.aead_seal_burst(self._send.key, self._send.nonce,
                                       chunks)
         if wire is not None:
             self._send.nonce += len(chunks)
         else:
-            # no native kernels: per-frame python seal, still one sendall
+            # no native kernels: per-frame python seal, same bytes
             parts = []
             for chunk in chunks:
                 sealed = self._send.seal(
@@ -248,7 +250,21 @@ class SecretConnection:
         if t0:
             _m_seal.observe(time.perf_counter() - t0)
             _m_sealed.inc(len(chunks))
-        self.conn.sendall(wire)
+        return wire
+
+    def _seal_and_send_locked(self, chunks: List[bytes]) -> None:
+        self.conn.sendall(self._seal_wire_locked(chunks))
+
+    def seal_frames(self, chunks: List[bytes]) -> bytes:
+        """Non-blocking codec surface for the loop reactor: the wire
+        bytes for `chunks` (one <=1024B frame each) WITHOUT touching
+        the socket — byte-identical to what write_many sends. The loop
+        owns the socket; the link owns the cipher stream."""
+        for c in chunks:
+            if len(c) > DATA_MAX_SIZE:
+                raise ValueError(f"frame chunk exceeds {DATA_MAX_SIZE}B")
+        with self._send_lock:
+            return self._seal_wire_locked(list(chunks))
 
     def read(self) -> bytes:
         """One frame's plaintext (<=1024B). b'' on clean EOF."""
@@ -317,6 +333,11 @@ class SecretConnection:
             del self._rbuf[:4 + clen]
             if limit and len(sealed) >= limit:
                 break
+        return self._open_sealed_locked(sealed)
+
+    def _open_sealed_locked(self, sealed: List[bytes]) -> List[bytes]:
+        if not sealed:
+            return []
         t0 = time.perf_counter() if telemetry.enabled() else 0.0
         plains = None
         if len(sealed) > 1:
@@ -330,6 +351,26 @@ class SecretConnection:
             _m_open.observe(time.perf_counter() - t0)
             _m_opened.inc(len(sealed))
         return [_strip_frame(p) for p in plains]
+
+    def feed_wire(self, data: bytes) -> List[bytes]:
+        """Non-blocking codec surface for the loop reactor: append raw
+        socket bytes to the read-ahead buffer and return every COMPLETE
+        frame's plaintext (one burst open). Never reads the socket;
+        partial frames stay buffered until the next feed. feed_wire(b'')
+        drains frames the handshake's over-read already buffered."""
+        with self._rlock:
+            if data:
+                self._rbuf += data
+            sealed: List[bytes] = []
+            while len(self._rbuf) >= 4:
+                (clen,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+                if clen > DATA_MAX_SIZE + 2 + _TAG:
+                    raise ValueError(f"oversized secret frame: {clen}")
+                if len(self._rbuf) < 4 + clen:
+                    break
+                sealed.append(bytes(self._rbuf[4:4 + clen]))
+                del self._rbuf[:4 + clen]
+            return self._open_sealed_locked(sealed)
 
     def close(self) -> None:
         # shutdown wakes any recv() blocked in another thread and sends

@@ -139,5 +139,23 @@ class FuzzedLink:
             if kept:
                 return kept
 
+    def seal_frames(self, chunks) -> bytes:
+        """Loop-reactor codec surface: per-frame fuzz applied BEFORE the
+        inner seal, so a loop-mode connection cannot bypass fault
+        injection; survivors seal in one inner burst (wire stays
+        burst-framed). Dropped frames simply never reach the wire."""
+        kept = [c for c in chunks if not self._fuzz("write")]
+        if not kept:
+            return b""
+        return self.link.seal_frames(kept)
+
+    def feed_wire(self, data: bytes):
+        """Loop-reactor codec surface: inner decode, then per-frame
+        read fuzz over the decoded burst. [] just means nothing
+        survived this readiness event (the loop, unlike read_burst's
+        blocking contract, never interprets [] as EOF)."""
+        frames = self.link.feed_wire(data)
+        return [f for f in frames if not self._fuzz("read")]
+
     def close(self) -> None:
         self.link.close()

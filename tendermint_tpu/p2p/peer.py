@@ -41,11 +41,13 @@ class Peer:
                  outbound: bool, persistent: bool = False,
                  dial_addr: Optional[NetAddress] = None,
                  send_rate: float = 512_000, recv_rate: float = 512_000,
-                 ping_interval: float = 10.0, idle_timeout: float = 35.0):
+                 ping_interval: float = 10.0, idle_timeout: float = 35.0,
+                 loop=None):
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
         self.dial_addr = dial_addr
+        self.loop = loop
         # channels the REMOTE advertised: sends on others are no-ops —
         # the receiving MConnection treats unknown channels as a protocol
         # violation (p2p/node_info.go channel negotiation)
@@ -55,12 +57,23 @@ class Peer:
             lambda ch, p, m: None
         self._on_error: Callable[["Peer", Exception], None] = \
             lambda p, e: None
-        self.mconn = MConnection(
-            link, channel_descs,
-            on_receive=lambda ch, m: self._on_receive(ch, self, m),
-            on_error=lambda e: self._on_error(self, e),
-            send_rate=send_rate, recv_rate=recv_rate,
-            ping_interval=ping_interval, idle_timeout=idle_timeout)
+        if loop is not None:
+            # async reactor core (ISSUE 12): the node's ONE event loop
+            # owns this peer's socket — no send/recv threads
+            from tendermint_tpu.p2p.conn.loop import LoopMConnection
+            self.mconn = LoopMConnection(
+                loop, link, channel_descs,
+                on_receive=lambda ch, m: self._on_receive(ch, self, m),
+                on_error=lambda e: self._on_error(self, e),
+                send_rate=send_rate, recv_rate=recv_rate,
+                ping_interval=ping_interval, idle_timeout=idle_timeout)
+        else:
+            self.mconn = MConnection(
+                link, channel_descs,
+                on_receive=lambda ch, m: self._on_receive(ch, self, m),
+                on_error=lambda e: self._on_error(self, e),
+                send_rate=send_rate, recv_rate=recv_rate,
+                ping_interval=ping_interval, idle_timeout=idle_timeout)
 
     # identity ---------------------------------------------------------------
 

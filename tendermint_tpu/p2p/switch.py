@@ -69,7 +69,7 @@ class SwitchError(Exception):
 
 class Switch:
     def __init__(self, config, node_key: NodeKey, node_info: NodeInfo,
-                 encrypt: bool = True):
+                 encrypt: bool = True, loop=None):
         from tendermint_tpu.utils.log import get_logger
         # bound node id: several switches share a test process, and a
         # p2p line is useless without knowing WHOSE switch logged it
@@ -78,6 +78,11 @@ class Switch:
         self.node_key = node_key
         self.node_info = node_info
         self.encrypt = encrypt
+        # async reactor core (ISSUE 12): when the node hands us its
+        # ReactorLoop, every peer socket runs on it (LoopMConnection)
+        # and reactors run per-peer gossip as cooperative tasks; None =
+        # the thread-per-connection plane, byte-for-byte
+        self.loop = loop
         self.reactors: Dict[str, object] = {}
         self.channel_descs: List[ChannelDescriptor] = []
         self.reactors_by_ch: Dict[int, object] = {}
@@ -317,7 +322,8 @@ class Switch:
             send_rate=getattr(self.config, "send_rate", 512_000),
             recv_rate=getattr(self.config, "recv_rate", 512_000),
             ping_interval=getattr(self.config, "ping_interval_s", 10.0),
-            idle_timeout=getattr(self.config, "idle_timeout_s", 35.0))
+            idle_timeout=getattr(self.config, "idle_timeout_s", 35.0),
+            loop=self.loop)
         peer.set_handlers(self._route, self._peer_error)
 
         if not self.peers.add(peer):
@@ -349,12 +355,14 @@ class Switch:
             # own peer from the PeerSet (stop_peer_for_error race) must
             # still be joined by Switch.stop(). Prune entries whose
             # conn threads have exited to bound growth under churn —
-            # but KEEP not-yet-started entries (empty thread list):
-            # another thread may be between registering and start().
+            # but KEEP not-yet-started entries (empty thread list,
+            # still running): another thread may be between registering
+            # and start(). Loop-mode conns have no threads; prune them
+            # once stopped (their teardown ran on the loop).
             self._started_peers = [
                 p for p in self._started_peers
-                if not p.mconn._threads or
-                any(t.is_alive() for t in p.mconn._threads)]
+                if (any(t.is_alive() for t in p.mconn._threads)
+                    if p.mconn._threads else p.mconn.running)]
             self._started_peers.append(peer)
         peer.start()
         if self.trust_store is not None:

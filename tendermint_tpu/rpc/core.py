@@ -77,11 +77,104 @@ class RPCEnv:
             stall_detector=getattr(node, "_stall_detector", None))
 
 
+_m_tx_batched = None   # registered lazily by TxBatcher (keeps this
+#                        module import-light for the lint's route scan)
+
+
+class TxBatcher:
+    """Front-door admission coalescing (the PR 2 coalescer's pattern at
+    the RPC boundary): concurrent broadcast_tx_sync/async calls arriving
+    within a short linger merge into ONE Mempool.check_tx_batch — one
+    proxy_mtx acquisition and one tx-WAL append for the whole batch.
+    Per-call verdicts demux back to each waiter."""
+
+    def __init__(self, mempool, wait_s: float = 0.002,
+                 max_batch: int = 256):
+        global _m_tx_batched
+        from tendermint_tpu import telemetry
+        if _m_tx_batched is None:
+            _m_tx_batched = (
+                telemetry.counter(
+                    "rpc_tx_batched_total",
+                    "broadcast_tx admissions served through the "
+                    "front-door batcher"),
+                telemetry.counter(
+                    "rpc_tx_batch_flushes_total",
+                    "check_tx_batch flushes issued by the front-door "
+                    "batcher"))
+        self.mempool = mempool
+        self.wait_s = wait_s
+        self.max_batch = max_batch
+        self._cond = threading.Condition()
+        self._queue: list = []        #: guarded_by _cond
+        self._closed = False          #: guarded_by _cond
+        # eager worker: part of the node's fixed thread set from
+        # construction (lazy spawn reads as a thread leak to harnesses
+        # snapshotting live threads around a request)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tm-rpc-txbatch")
+        self._thread.start()
+
+    def submit(self, tx: bytes, wait: bool = True):
+        """Queue one tx; wait=True blocks for its ResultCheckTx."""
+        import queue as _qmod
+        slot: Optional[_qmod.SimpleQueue] = \
+            _qmod.SimpleQueue() if wait else None
+        with self._cond:
+            if self._closed:
+                raise RPCError(-32000, "tx batcher closed")
+            self._queue.append((bytes(tx), slot))
+            self._cond.notify()
+        if slot is None:
+            return None
+        res = slot.get()
+        if isinstance(res, BaseException):
+            raise res
+        return res
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._queue:
+                    return
+            time.sleep(self.wait_s)   # linger: let a burst accumulate
+            with self._cond:
+                batch, self._queue = (self._queue[:self.max_batch],
+                                      self._queue[self.max_batch:])
+            if not batch:
+                continue
+            txs = [tx for tx, _ in batch]
+            try:
+                results = self.mempool.check_tx_batch(txs)
+            except Exception as e:
+                results = [e] * len(batch)
+            _m_tx_batched[0].inc(len(batch))
+            _m_tx_batched[1].inc()
+            for (_, slot), res in zip(batch, results):
+                if slot is not None:
+                    slot.put(res)
+
+
 class RPCCore:
     def __init__(self, env: RPCEnv):
         self.env = env
+        self.tx_batcher: Optional[TxBatcher] = None
         self._profiler = None
         self._profiler_lock = threading.Lock()
+
+    def enable_tx_batching(self) -> None:
+        """Async front door: coalesce concurrent broadcast_tx
+        admissions (no-op when the mempool lacks check_tx_batch)."""
+        if self.tx_batcher is None and \
+                hasattr(self.env.mempool, "check_tx_batch"):
+            self.tx_batcher = TxBatcher(self.env.mempool)
 
     def routes(self) -> Dict[str, Any]:
         """rpc/core/routes.go:8-37 (+ unsafe :39-50)."""
@@ -314,6 +407,18 @@ class RPCCore:
 
     def _check_tx(self, tx: bytes):
         from tendermint_tpu.mempool import MempoolFull, TxAlreadyInCache
+        if self.tx_batcher is not None:
+            # front-door coalescing: one mempool lock + WAL append per
+            # merged batch; admission errors come back as result codes
+            # and map onto the same RPCError surface as the direct path
+            res = self.tx_batcher.submit(tx)
+            if isinstance(res, Exception):
+                raise RPCError(-32000, str(res))
+            if res.code != 0 and (
+                    res.log == "tx already in cache" or
+                    res.log.startswith("mempool is full")):
+                raise RPCError(-32000, res.log)
+            return res
         try:
             return self.env.mempool.check_tx(tx)
         except TxAlreadyInCache:
@@ -322,11 +427,15 @@ class RPCCore:
             raise RPCError(-32000, str(e))
 
     def broadcast_tx_async(self, tx: bytes) -> dict:
-        """Fire-and-forget (rpc/core/mempool.go:51). The local CheckTx
-        still runs inline — our mempool API is synchronous."""
-        threading.Thread(target=lambda: self._try_check(tx),
-                         daemon=True).start()
+        """Fire-and-forget (rpc/core/mempool.go:51). With the front-door
+        batcher (async server) the tx rides the next merged
+        check_tx_batch; the threaded path keeps its one-off thread."""
         import hashlib
+        if self.tx_batcher is not None:
+            self.tx_batcher.submit(tx, wait=False)
+        else:
+            threading.Thread(target=lambda: self._try_check(tx),
+                             daemon=True).start()
         return jsonify({"hash": hashlib.sha256(tx).digest()})
 
     def _try_check(self, tx: bytes) -> None:
@@ -629,6 +738,22 @@ class RPCCore:
             raise RPCError(-32602, f"bad query: {e}")
         sub = bus.subscribe(ws.subscriber_id, query)
 
+        attach = getattr(ws, "attach_subscription", None)
+        if attach is not None:
+            # async front door: loop-native fan-out, zero threads per
+            # subscriber — the drain renders each event exactly like
+            # the pump below
+            def render(item):
+                return {"jsonrpc": "2.0", "id": "#event",
+                        "result": {"query": item.query,
+                                   "data": jsonify(item.data),
+                                   "tags": jsonify(item.tags)}}
+
+            attach(sub, render)
+            ws.on_close.append(
+                lambda w: bus.unsubscribe_all(w.subscriber_id))
+            return {}
+
         def pump():
             while ws.open and not sub.cancelled:
                 try:
@@ -658,12 +783,21 @@ class RPCCore:
         return {}
 
 
-def make_server(env: RPCEnv):
-    """Assemble an RPCServer with the full route table."""
+def make_server(env: RPCEnv, loop=None):
+    """Assemble a server with the full route table: the threaded
+    RPCServer by default, or — when handed the node's ReactorLoop —
+    the async front door (rpc/aserver.py) serving every connection on
+    that loop, with broadcast_tx admission batching enabled."""
     from tendermint_tpu import telemetry
-    from tendermint_tpu.rpc.server import RPCServer
     core = RPCCore(env)
-    server = RPCServer()
+    if loop is not None:
+        from tendermint_tpu.rpc.aserver import AsyncRPCServer
+        server = AsyncRPCServer(loop)
+        core.enable_tx_batching()
+        server._tx_batcher = core.tx_batcher
+    else:
+        from tendermint_tpu.rpc.server import RPCServer
+        server = RPCServer()
     server.register_all(core.routes())
     for name, fn in core.ws_routes().items():
         server.register(name, fn, ws_only=True)

@@ -77,12 +77,31 @@ MAX_DEPTH = 48
 
 # Leaf frames in these files are Python-visible thread parks, not CPU
 # burn: Condition.wait / Event.wait / queue.get spin inside
-# threading.py; the RPC accept loop sits in selectors.py/socketserver.
+# threading.py; the RPC accept loop sits in selectors.py/socketserver;
+# concurrent.futures workers park in thread.py on a C-level
+# SimpleQueue.get; a leaf in socket.py is a blocking accept/recv/
+# connect (C call under a socket.py wrapper frame).
 _WAIT_FILES = ("threading.py", "selectors.py", "socketserver.py",
-               "queue.py")
+               "queue.py", "thread.py", "socket.py")
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) \
     + os.sep
+
+# The async reactor core's machinery files are TRANSPARENT to
+# attribution: a consensus gossip pass or an RPC handler running as a
+# loop callback must charge its samples to consensus/rpc, not to one
+# opaque bucket under the loop's module path. Frames in these files
+# never claim the subsystem; when a stack never leaves them (selector
+# dispatch, seal/flush bookkeeping) the ``__owner__`` tag carried by
+# ReactorLoop._invoke names the subsystem that scheduled the callback,
+# and a stack with neither (the idle select park) lands in ``loop``.
+_LOOP_FILES = (os.sep + os.path.join("p2p", "conn", "loop.py"),
+               os.sep + os.path.join("rpc", "aserver.py"))
+
+
+def _is_loop_file(filename: str) -> bool:
+    return filename.endswith(_LOOP_FILES[0]) or \
+        filename.endswith(_LOOP_FILES[1])
 
 # config.base.prof / prof_hz snapshot (node.py configure()); env wins
 # inside enabled()/default_hz(), so bare components honor the knobs too.
@@ -152,6 +171,7 @@ class SamplingProfiler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_ns = 0
+        self._last_threads = 0   # last sweep's live-thread count
 
     # ------------------------------------------------------------ control
 
@@ -212,25 +232,34 @@ class SamplingProfiler:
             n_threads += 1
             self._record(frame,
                          _normalize_thread(names.get(tid, "?")))
+        self._last_threads = n_threads
         _m_threads.set(n_threads)
 
     def _record(self, frame, thread: str) -> None:
         stack: List[str] = []
         subsystem = None
+        owner = None
+        saw_loop = False
         leaf_file = frame.f_code.co_filename
         is_wait = os.path.basename(leaf_file) in _WAIT_FILES
         depth = 0
         while frame is not None and depth < MAX_DEPTH:
             code = frame.f_code
             if subsystem is None:
-                subsystem = _subsystem_of(code.co_filename)
+                if _is_loop_file(code.co_filename):
+                    saw_loop = True
+                    if owner is None and code.co_name == "_invoke":
+                        owner = frame.f_locals.get("__owner__")
+                else:
+                    subsystem = _subsystem_of(code.co_filename)
             mod = os.path.basename(code.co_filename)
             if mod.endswith(".py"):
                 mod = mod[:-3]
             stack.append(f"{mod}.{code.co_name}")
             frame = frame.f_back
             depth += 1
-        subsystem = subsystem or "other"
+        subsystem = subsystem or owner or \
+            ("loop" if saw_loop else None) or "other"
         stack.reverse()  # collapsed format is root -> leaf
         if is_wait:
             stack.append("[lock_wait]")
@@ -293,6 +322,7 @@ class SamplingProfiler:
                 "stacks_dropped": self._dropped,
                 "subsystems": dict(self._subsys),
                 "lock_wait": dict(self._waits),
+                "n_threads": self._last_threads,
             }
         doc["shares"] = self.subsystem_shares()
         doc["collapsed"] = self.collapsed()
@@ -363,10 +393,13 @@ def merge_dumps(dumps: List[dict]) -> dict:
     waits: Dict[str, int] = {}
     samples = waits_total = 0
     nodes = []
+    threads_per_node: Dict[str, int] = {}
     for d in dumps:
         prof = d.get("profile", d)  # RPC envelope or bare snapshot
         node = str(d.get("node", "") or f"n{len(nodes)}")
         nodes.append(node)
+        if prof.get("n_threads"):
+            threads_per_node[node] = int(prof["n_threads"])
         for line in (prof.get("collapsed") or "").splitlines():
             if line.strip():
                 collapsed.append(f"node:{node};{line}")
@@ -384,4 +417,5 @@ def merge_dumps(dumps: List[dict]) -> dict:
     return {"nodes": nodes, "samples": samples,
             "wait_samples": waits_total, "subsystems": subsys,
             "lock_wait": waits, "shares": shares,
+            "threads_per_node": threads_per_node,
             "collapsed": "\n".join(collapsed)}

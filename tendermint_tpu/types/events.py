@@ -136,6 +136,11 @@ class Subscription:
         self.capacity = max(1, int(capacity))
         self.cancelled = False
         self.dropped = 0
+        # optional push hook (async RPC server): called after every
+        # put(), OUTSIDE the buffer lock, on the publisher's thread —
+        # the loop-mode WebSocket fan-out schedules its drain here
+        # instead of running a pump thread per subscriber
+        self.on_put: Optional[Callable[[], None]] = None
         self._items: "deque[EventItem]" = deque()
         self._cond = threading.Condition()
         # queue observatory: a saturated subscriber buffer means a slow
@@ -155,6 +160,9 @@ class Subscription:
                 self.dropped += 1
             self._items.append(item)
             self._cond.notify()
+        hook = self.on_put
+        if hook is not None:
+            hook()
         return dropped
 
     def get(self, timeout: Optional[float] = None) -> EventItem:

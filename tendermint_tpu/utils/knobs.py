@@ -94,6 +94,22 @@ CATALOG: tuple[Knob, ...] = (
     Knob("TM_TPU_P2P_BURST", "spec", "auto", "base.p2p_burst",
          "Burst frame plane: off|on|auto|<max packets per burst>.",
          "p2p/conn/burst.py"),
+    # -- async reactor core ------------------------------------------------
+    Knob("TM_TPU_REACTOR", "str", "auto (= loop)", "base.reactor",
+         "Socket plane: loop runs every peer socket, gossip routine and "
+         "RPC connection on ONE selector event loop per node; threads "
+         "restores the per-connection thread plane byte-for-byte (the "
+         "wire-parity / chaos-replay escape hatch).",
+         "p2p/conn/loop.py"),
+    Knob("TM_TPU_RPC_MAX_CONNS", "int", "0 (= 4096 loop mode)", "",
+         "Admission cap on concurrent RPC/WebSocket connections in "
+         "loop mode; over-cap connects get an immediate 503.",
+         "rpc/aserver.py"),
+    Knob("TM_TPU_RPC_RATE", "float", "0 (off)", "",
+         "Per-client-IP JSON-RPC request rate limit (requests/sec, "
+         "2x burst) in loop mode; over-limit calls get a structured "
+         "rate-limit error and count tm_rpc_rate_limited_total.",
+         "rpc/aserver.py"),
     # -- block hot-path pipeline -------------------------------------------
     Knob("TM_TPU_PIPELINE", "str", "auto", "base.pipeline",
          "Pipelined per-height hot path (native part-set build, "

@@ -229,6 +229,26 @@ def test_chaos_smoke_drop_delay_crash():
     assert r["checks"]["agreement"] > 0
 
 
+def test_relay_gossip_dedup_skips_redundant_deliveries():
+    """ISSUE 12 satellite: a duplicate-heavy run must skip re-
+    delivering byte-identical vote/part messages a destination already
+    consumed (the O(n²) residual PR 11 flagged) — with the SAME
+    verdict: zero violations, every node caught up. And dedup must not
+    break the determinism witness: two runs of one (spec, seed) still
+    produce one fault log."""
+    from tendermint_tpu.chaos.runner import run_chaos
+    spec = {"drop": 0.02, "duplicate": 0.5, "delay": 0.05,
+            "delay_steps": [1, 2]}
+    r1 = run_chaos(spec=spec, seed=11, target_height=4, max_steps=400)
+    assert r1["violations"] == []
+    assert r1["max_height"] >= 4
+    assert r1["relay_dedup_skips"] > 0, \
+        "duplicate faults must produce provably-redundant deliveries"
+    r2 = run_chaos(spec=spec, seed=11, target_height=4, max_steps=400)
+    assert r1["fault_log_sha256"] == r2["fault_log_sha256"]
+    assert r1["relay_dedup_skips"] == r2["relay_dedup_skips"]
+
+
 @pytest.mark.slow
 def test_chaos_acceptance_scenario():
     """The BENCH_chaos.json scenario: drop/delay/duplicate/reorder,
