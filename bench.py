@@ -638,6 +638,137 @@ def bench_p2p_json(path: str = "BENCH_p2p.json",
     return doc
 
 
+#: the wirechaos bench's fault schedule: every wire fault kind inside a
+#: 30s measured window, every episode healed >=10s before the window
+#: ends so recovery latencies land inside the monitor's view. Steps are
+#: 25ms: partition isolates node 3 for 4s, a slow-loris stall freezes
+#: the 0<->1 link for 2s, and two mid-stream resets hit live conns.
+WIRECHAOS_SPEC = {
+    "drop": 0.0008,
+    "corrupt": 0.0005,
+    "delay": 0.10, "delay_steps": [1, 3],
+    "partitions": [{"start": 160, "stop": 320,
+                    "groups": [[3], [0, 1, 2]]}],
+    "stalls": [{"start": 400, "stop": 480, "links": [[0, 1], [1, 0]]}],
+    "resets": [{"at": 560, "links": [[1, 2]]},
+               {"at": 680, "links": [[2, 3]]}],
+    "step_ms": 25,
+}
+
+WIRECHAOS_HOSTILE = ("garbage_after_auth", "handshake_stall",
+                     "slow_handshake", "flood")
+
+
+def bench_wirechaos_json(path: str = "BENCH_wirechaos.json",
+                         seed: int = 42) -> dict:
+    """Socket-plane adversarial trajectory point (ISSUE 13): the
+    4-validator loop-plane socket testnet run CLEAN and then under a
+    seeded wire-fault schedule (TCP fault proxy on every directed p2p
+    link: latency/loss/corruption/resets/stalls/partition) PLUS four
+    concurrent hostile-peer scripts against node0's real listener. The
+    RPC-polling SocketInvariantMonitor asserts agreement + AppHash
+    identity per height, per-node monotonicity, and bounded recovery
+    after each episode heals; the ban plane must ban the garbage peer
+    and re-admit it after the (shortened) ban decays. The determinism
+    witness constructs the schedule twice: plan digests and per-conn
+    decision-stream digests must be byte-identical."""
+    import bench_testnet
+    from tendermint_tpu.chaos.wire import WireSchedule
+
+    duration = float(os.environ.get("TM_BENCH_WIRECHAOS_S", "30"))
+    n_vals = 4
+
+    def stream_digests(sched: WireSchedule) -> dict:
+        return {f"{i}->{j}": sched.link_stream(i, j, 0).digest(500)
+                for i in range(n_vals) for j in range(n_vals)
+                if i != j}
+
+    s1 = WireSchedule(WIRECHAOS_SPEC, seed=seed, n_nodes=n_vals)
+    s2 = WireSchedule(WIRECHAOS_SPEC, seed=seed, n_nodes=n_vals)
+    d1, d2 = stream_digests(s1), stream_digests(s2)
+    determinism = {
+        "seed": seed,
+        "plan_sha256": s1.plan_digest(),
+        "plan_reproduced": s1.plan_digest() == s2.plan_digest(),
+        "decision_streams_reproduced": d1 == d2,
+        "decision_stream_sha256_0to1": d1["0->1"],
+    }
+    assert determinism["plan_reproduced"] and \
+        determinism["decision_streams_reproduced"], \
+        "wire schedule is not deterministic"
+
+    # hostile-peer defense knobs, shortened so the full ban lifecycle
+    # (ban -> rejected redials -> decay -> re-admission) fits the
+    # window; handshake deadline shortened the same way so the stall
+    # scripts observe their disconnect in-bench
+    child_env = {"TM_TPU_P2P_BAN_BASE_S": "6",
+                 "TM_TPU_P2P_BAN_SCORE": "30"}
+    p2p_cfg = {"handshake_timeout_s": 5.0}
+
+    print("[bench] wirechaos clean arm...", file=sys.stderr, flush=True)
+    clean = bench_testnet.run_socket(duration_s=duration,
+                                     reactor="loop")
+    print("[bench] wirechaos faulted arm...", file=sys.stderr,
+          flush=True)
+    faulted = bench_testnet.run_socket(
+        duration_s=duration, reactor="loop",
+        wire_chaos=WIRECHAOS_SPEC, wire_seed=seed,
+        hostile=WIRECHAOS_HOSTILE, child_env=child_env,
+        p2p_cfg=p2p_cfg)
+
+    wire = faulted.get("wire", {})
+    monitor = wire.get("monitor", {})
+    hostile = {r.get("script", "?"): r for r in wire.get("hostile", ())}
+    garbage = hostile.get("garbage_after_auth", {})
+    ratio = round(faulted["blocks_per_sec"] /
+                  clean["blocks_per_sec"], 3) \
+        if clean.get("blocks_per_sec") else None
+    doc = {
+        "metric": "wirechaos_blocks_ratio",
+        "unit": "x (faulted / clean blocks per sec)",
+        "value": ratio,
+        "workload": "4-validator loop-plane socket testnet, 1000-tx "
+                    "blocks; faulted arm adds the seeded wire-fault "
+                    "proxy on every p2p link + 4 hostile-peer scripts "
+                    "against node0",
+        "source": "chaos.wire proxy + SocketInvariantMonitor (RPC "
+                  "polling) + per-node tm_p2p_ban*/tm_wire_* scrapes",
+        "seed": seed,
+        "duration_s_per_arm": duration,
+        "clean": {k: clean.get(k) for k in
+                  ("blocks_per_sec", "txs_per_sec", "blocks",
+                   "avg_txs_per_block")},
+        "faulted": {k: faulted.get(k) for k in
+                    ("blocks_per_sec", "txs_per_sec", "blocks",
+                     "avg_txs_per_block")},
+        "faulted_over_clean_blocks_ratio": ratio,
+        "wire_spec": WIRECHAOS_SPEC,
+        "plan": wire.get("plan"),
+        "plan_sha256": wire.get("plan_sha256"),
+        "faults_applied": wire.get("faults_applied"),
+        "recovery": monitor.get("recovery"),
+        "invariants": {
+            "checks": monitor.get("checks"),
+            "checks_total": monitor.get("checks_total"),
+            "violations": monitor.get("violations"),
+            "app_hash_chain_identical":
+                monitor.get("app_hash_chain_identical"),
+            "heights_audited_all_nodes":
+                monitor.get("heights_audited_all_nodes"),
+        },
+        "hostile": wire.get("hostile"),
+        "ban_lifecycle": {
+            "saw_ban": garbage.get("saw_ban"),
+            "readmitted_after_ban": garbage.get("readmitted_after_ban"),
+            "ban_metrics": wire.get("ban_metrics"),
+        },
+        "determinism": determinism,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 class _WSSubHarness:
     """Selector-based WebSocket subscriber fleet — thousands of client
     sockets in ONE thread, so the bench process can outnumber the
@@ -1924,6 +2055,12 @@ if __name__ == "__main__":
         # standalone quick mode: only the BENCH_p2p.json satellite
         # (socket testnet, reactor loop vs threads)
         print(json.dumps(bench_p2p_json()), flush=True)
+        sys.exit(0)
+    if "--wirechaos-json" in sys.argv:
+        # standalone quick mode: only the BENCH_wirechaos.json
+        # satellite (loop-plane socket testnet clean vs seeded
+        # wire-fault proxy + hostile peers + invariant monitor)
+        print(json.dumps(bench_wirechaos_json()), flush=True)
         sys.exit(0)
     if "--rpc-json" in sys.argv:
         # standalone quick mode: only the BENCH_rpc.json satellite

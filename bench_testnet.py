@@ -345,11 +345,30 @@ def _scrape_chaos_metrics(client) -> dict:
     return out
 
 
+def _scrape_ban_metrics(client) -> dict:
+    """tm_p2p_bans/unbans/peer_errors/accept_shed/handshake_failures
+    from one node's /metrics — the hostile-peer defense witness."""
+    import re
+    text = client.call("metrics")["exposition"]
+    out = {}
+    for line in text.splitlines():
+        m = re.match(
+            r'^(tm_p2p_(?:bans|unbans|peer_errors|accept_shed|'
+            r'handshake_failures|frame_error_disconnects)_total|'
+            r'tm_p2p_banned_peers)(\{[^}]*\})? ([0-9.e+-]+)$', line)
+        if m:
+            out[m.group(1) + (m.group(2) or "")] = int(float(m.group(3)))
+    return out
+
+
 def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                duration_s: float = 25.0, burst: str = "",
                chaos: str = "", pipeline: str = "",
                parity: bool = False, trace: str = "",
-               profile: str = "", reactor: str = "") -> dict:
+               profile: str = "", reactor: str = "",
+               wire_chaos: dict = None, wire_seed: int = 0,
+               hostile: tuple = (), liveness_bound_s: float = 30.0,
+               child_env: dict = None, p2p_cfg: dict = None) -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -390,6 +409,10 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         #         loop = one event loop per node, threads = the
         #         per-connection thread plane; "" inherits caller env
         env["TM_TPU_REACTOR"] = reactor
+    if child_env:  # per-run node knobs (bench.py --wirechaos-json uses
+        #           this to shorten ban windows so the unban shows up
+        #           inside the measured window)
+        env.update(child_env)
 
     net = tempfile.mkdtemp(prefix="bench-socknet-")
     base = free_port_block(2 * n_vals)
@@ -411,7 +434,42 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         # the 1000-tx reap cap, small enough that per-commit
         # recheck + mempool-WAL rewrite stay O(small)
         cfg["mempool"] = dict(cfg.get("mempool", {}), size=4000)
+        if p2p_cfg:
+            # per-run p2p overrides (the wirechaos bench shortens the
+            # handshake deadline so slow-loris disconnects land inside
+            # the measured window)
+            cfg["p2p"] = dict(cfg.get("p2p", {}), **p2p_cfg)
         _json.dump(cfg, open(cfg_path, "w"))
+
+    # wire-level chaos (ISSUE 13): route every directed p2p link
+    # through the seeded TCP fault proxy — node i's persistent_peers
+    # entry for node j points at proxy port (i, j), which forwards to
+    # j's real listener injecting the schedule's faults. PEX is
+    # disabled so no conn can discover a direct (unproxied) address.
+    proxy = wire_sched = wire_monitor = None
+    wire_t0 = None
+    hostile_threads: list = []
+    hostile_reports: list = []
+    if wire_chaos is not None:
+        from tendermint_tpu.chaos import wire as wire_mod
+        proxy, wire_sched = wire_mod.proxy_for_testnet(
+            wire_chaos, wire_seed, n_vals, lambda j: base + 2 * j)
+        for i in range(n_vals):
+            cfg_path = os.path.join(net, f"node{i}", "config",
+                                    "config.json")
+            cfg = _json.load(open(cfg_path))
+            peers = []
+            for entry in cfg["p2p"]["persistent_peers"].split(","):
+                if not entry:
+                    continue
+                pid, hostport = entry.split("@", 1)
+                port = int(hostport.rsplit(":", 1)[1])
+                j = (port - base) // 2
+                peers.append(f"{pid}@127.0.0.1:{proxy.ports[(i, j)]}")
+            cfg["p2p"]["persistent_peers"] = ",".join(peers)
+            cfg["p2p"]["pex"] = False
+            _json.dump(cfg, open(cfg_path, "w"))
+        proxy.start()
 
     procs, logs = [], []
     cleanup_ok = [False]
@@ -510,6 +568,45 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 pass  # node busy/restarting; check_alive decides
             time.sleep(1.0)
 
+        if proxy is not None:
+            # faults begin WITH the measured window (boot + prefill ran
+            # on a clean wire); the monitor sees exactly what an
+            # operator's scrape would
+            from tendermint_tpu.chaos import wire as wire_mod
+            wire_t0 = proxy.arm()
+            wire_monitor = wire_mod.SocketInvariantMonitor(
+                [f"http://127.0.0.1:{base + 2 * i + 1}"
+                 for i in range(n_vals)])
+            wire_monitor.start()
+        for script in hostile:
+            # hostile peers aim at node0's REAL p2p listener — the
+            # defenses under test live in the victim, not the proxy
+            from tendermint_tpu.chaos import hostile as hostile_mod
+
+            def run_script(s=script):
+                kw = {}
+                if s == "garbage_after_auth":
+                    kw = {"rounds": 12, "retry_gap_s": 1.2,
+                          "budget_s": duration_s + 10}
+                elif s == "flood":
+                    kw = {"count": 48, "hold_s": 2.0}
+                elif s == "slow_handshake":
+                    kw = {"byte_interval_s": 0.5,
+                          "budget_s": min(20.0, duration_s)}
+                elif s == "handshake_stall":
+                    kw = {"budget_s": min(20.0, duration_s)}
+                try:
+                    hostile_reports.append(hostile_mod.run_hostile(
+                        s, "127.0.0.1", base, network="bench-socknet",
+                        channels=[], **kw))
+                except Exception as e:
+                    hostile_reports.append({"script": s,
+                                            "error": repr(e)})
+            t = threading.Thread(target=run_script, daemon=True,
+                                 name=f"hostile-{script}")
+            t.start()
+            hostile_threads.append(t)
+
         h0 = clients[0].call("status")["latest_block_height"]
         t0 = time.perf_counter()
         end_at = time.monotonic() + duration_s
@@ -519,6 +616,38 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         h1 = clients[0].call("status")["latest_block_height"]
         dt = time.perf_counter() - t0
         stop.set()
+        wire_report = {}
+        if proxy is not None:
+            for t in hostile_threads:
+                t.join(timeout=20.0)
+            # grace so the monitor can observe post-heal progress for
+            # late episodes, then judge
+            time.sleep(3.0)
+            ends = []
+            for ep in wire_sched.episodes():
+                end_t = wire_t0 + ep["end"] * wire_sched.step_ms / 1e3
+                if end_t <= time.monotonic():
+                    ends.append((ep["kind"], end_t))
+            wire_monitor.stop()
+            bans = {}
+            for c in clients:
+                try:
+                    for k, v in _scrape_ban_metrics(c).items():
+                        bans[k] = bans.get(k, 0) + v
+                except (OSError, RPCClientError) as e:
+                    print(f"[bench] ban scrape failed: {e!r}",
+                          file=sys.stderr)
+            wire_report = {
+                "spec": wire_sched.spec, "seed": wire_sched.seed,
+                "step_ms": wire_sched.step_ms,
+                "plan": wire_sched.plan,
+                "plan_sha256": wire_sched.plan_digest(),
+                "faults_applied": wire_sched.applied_counts(),
+                "monitor": wire_monitor.finalize(
+                    ends, liveness_bound_s=liveness_bound_s),
+                "hostile": hostile_reports,
+                "ban_metrics": bans,
+            }
         try:
             p2p_metrics = _scrape_p2p_metrics(clients[0])
         except Exception:
@@ -591,6 +720,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             **({"parity": parity_report} if parity_report else {}),
             **({"chaos": chaos, "chaos_faults": chaos_metrics}
                if chaos_metrics else {}),
+            **({"wire": wire_report} if wire_report else {}),
             **({"timelines": timelines} if timelines else {}),
             **({"profiles": profiles} if profiles else {}),
         }
@@ -609,6 +739,10 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         raise
     finally:
         stop.set()
+        if wire_monitor is not None:
+            wire_monitor.stop()
+        if proxy is not None:
+            proxy.stop()
         for p in procs:
             p.terminate()
         for p in procs:

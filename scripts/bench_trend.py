@@ -93,6 +93,14 @@ EXTRACTORS = (
      "loop.subscribe_ack_p99_ms", "ms", "down"),
     ("rpc_subscriber_ratio_loop_vs_threads", "BENCH_rpc.json",
      "subscriber_ratio_loop_vs_threads", "x", "up"),
+    # the ISSUE-13 wire-chaos arm: how much of the clean commit rate
+    # the loop plane keeps under the seeded wire-fault schedule +
+    # hostile peers, and how fast the net recovers after each episode
+    # heals — regressions mean the socket plane got more fragile
+    ("wirechaos_blocks_ratio", "BENCH_wirechaos.json",
+     "faulted_over_clean_blocks_ratio", "x", "up"),
+    ("wirechaos_recovery_p50_s", "BENCH_wirechaos.json",
+     "recovery.latency_seconds.p50", "s", "down"),
     ("mesh_8dev_verifies_per_sec", "BENCH_mesh.json",
      "points[devices=8].verifies_per_sec", "verifies/sec", "up"),
     ("statesync_speedup_vs_replay", "BENCH_sync.json",

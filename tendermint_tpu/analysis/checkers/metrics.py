@@ -30,7 +30,7 @@ KNOWN_SUBSYSTEMS = {
     "verifier", "consensus", "mempool", "fastsync", "p2p", "merkle",
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
-    "snapshot", "sync", "prune", "prof", "queue", "loop",
+    "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
 }
 
 INSTRUMENTED_MODULES = [
@@ -56,6 +56,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.telemetry.queues",   # tm_queue_* backpressure plane
     "tendermint_tpu.p2p.conn.loop",      # tm_loop_* reactor-loop core
     "tendermint_tpu.rpc.aserver",        # tm_rpc_* async front door
+    "tendermint_tpu.chaos.wire",         # tm_wire_* TCP fault proxy
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

@@ -120,8 +120,16 @@ class P2PConfig:
     addr_book_strict: bool = True
     skip_upnp: bool = True   # opt-in UPnP (reference default differs;
     #                          zero-egress/test environments must not probe)
-    handshake_timeout_s: float = 20.0
+    handshake_timeout_s: float = 20.0   # TOTAL handshake deadline
     dial_timeout_s: float = 3.0
+    # hostile-peer hardening (ISSUE 13; env TM_TPU_P2P_BAN_SCORE /
+    # _BAN_BASE_S / _FD_HEADROOM win): trust score below ban_score =>
+    # banned for ban_base_s (doubling per repeat, decaying with clean
+    # time); inbound accepts shed when fewer than fd_headroom fds
+    # remain under the process limit
+    ban_score: int = 30
+    ban_base_s: float = 60.0
+    fd_headroom: int = 64
 
 
 @dataclass

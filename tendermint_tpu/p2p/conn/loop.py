@@ -533,6 +533,18 @@ class LoopMConnection:
         self._timers: List[_Timer] = []   # loop-thread only
         self._threads: tuple = ()         # API compat with MConnection
         _, self._burst_max = burst_cfg.resolve()
+        # send-burst amortization (ISSUE 13 satellite): a flush
+        # scheduled the instant the first message lands seals a burst
+        # of 1-5 frames, while the threaded plane's cond-wakeup drain
+        # averaged 10.6. The linger is a RATE LIMITER, not a delay: a
+        # send on an idle conn still flushes immediately, but once a
+        # flush has run, the next one waits out the window — so under
+        # sustained load sends accumulate into full bursts while
+        # sporadic (latency-critical) sends pay nothing. 0 = flush-
+        # per-wakeup, the PR 12 behavior byte-for-byte.
+        self._flush_linger_s = max(0.0, knobs.knob_float(
+            "TM_TPU_P2P_FLUSH_LINGER_MS", default=4.0)) / 1e3
+        self._last_flush = 0.0  # written on loop; racy reads benign
         self.drain_listeners: List[Callable[[], None]] = []
         self._queue_probes = [
             queue_obs.register(
@@ -671,6 +683,17 @@ class LoopMConnection:
             if self._flush_scheduled or self._stopped:
                 return
             self._flush_scheduled = True
+        linger = self._flush_linger_s
+        if linger > 0:
+            # cross-thread read of _last_flush is a benign race: a torn
+            # read only mis-sizes ONE linger window by at most `linger`
+            since = time.monotonic() - self._last_flush
+            if since < linger:
+                # a flush just ran: everything arriving inside the
+                # window rides the next seal as one burst
+                self.loop.call_later(linger - since, self._flush,
+                                     owner="p2p")
+                return
         self.loop.call_soon(self._flush, owner="p2p")
 
     def _pick_channel(self) -> Optional[_Channel]:
@@ -699,6 +722,7 @@ class LoopMConnection:
                 return
         if not self._attached:
             return  # _attach ends with a flush; queued data drains then
+        self._last_flush = time.monotonic()
         pause = self._send_ahead()
         if pause > 0.01:
             # non-blocking throttle: resume the flush when the sliding

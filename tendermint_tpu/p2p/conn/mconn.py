@@ -390,6 +390,13 @@ class MConnection:
             raise ValueError(f"unknown packet type {ptype:#x}")
 
 
+#: plain-frame ceiling: mconn packets are ~1KB and the handshake caps
+#: its message at 1MB, so any larger length prefix is a corrupt or
+#: hostile stream — without this check a forged 1GB prefix silently
+#: wedges the conn waiting for bytes that never come (ISSUE 13)
+PLAIN_FRAME_MAX = (1 << 20) + 64
+
+
 class PlainFramedConn:
     """Unencrypted link with the same 4-byte length framing — test double
     for SecretConnection and the fuzz wrapper's substrate."""
@@ -438,6 +445,8 @@ class PlainFramedConn:
             frames = []
             while len(self._rbuf) >= 4:
                 (n,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+                if n > PLAIN_FRAME_MAX:
+                    raise ValueError(f"oversized plain frame: {n}")
                 if len(self._rbuf) < 4 + n:
                     break
                 frames.append(bytes(self._rbuf[4:4 + n]))
@@ -460,6 +469,8 @@ class PlainFramedConn:
         frames = []
         while len(self._rbuf) >= 4:
             (n,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+            if n > PLAIN_FRAME_MAX:
+                raise ValueError(f"oversized plain frame: {n}")
             if len(self._rbuf) < 4 + n:
                 if frames:
                     break

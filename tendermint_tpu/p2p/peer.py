@@ -48,6 +48,10 @@ class Peer:
         self.persistent = persistent
         self.dial_addr = dial_addr
         self.loop = loop
+        # steady-state trust accounting (ISSUE 13): routed messages
+        # since the last good-event credit. Touched only by this
+        # peer's one delivery context (recv thread or the loop).
+        self._clean_msgs = 0
         # channels the REMOTE advertised: sends on others are no-ops —
         # the receiving MConnection treats unknown channels as a protocol
         # violation (p2p/node_info.go channel negotiation)
@@ -120,6 +124,18 @@ class Peer:
         if not self.has_channel(ch_id):
             return False
         return self.mconn.try_send(ch_id, msg)
+
+    def note_clean_msg(self, every: int) -> bool:
+        """Count one cleanly-routed message; True once per `every` —
+        the switch turns that into a trust good_event, so long-lived
+        honest peers accumulate standing a single bad burst can't
+        erase (the pre-ISSUE-13 asymmetry: good scored only at
+        add_peer, bad scored on every recv error)."""
+        self._clean_msgs += 1
+        if self._clean_msgs >= every:
+            self._clean_msgs = 0
+            return True
+        return False
 
     def send_obj(self, ch_id: int, obj: dict) -> bool:
         return self.send(ch_id, encoding.cdumps(obj))

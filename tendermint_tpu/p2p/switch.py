@@ -12,10 +12,12 @@ The full connection path for either direction:
 
 from __future__ import annotations
 
+import os
 import random
 import socket
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu.p2p.conn import ChannelDescriptor, SecretConnection
@@ -31,6 +33,7 @@ from tendermint_tpu.p2p.peer import (
 )
 from tendermint_tpu import telemetry
 from tendermint_tpu.types import encoding
+from tendermint_tpu.utils import clock, knobs
 
 _m_peers = telemetry.gauge(
     "p2p_peers", "Connected peers")
@@ -40,11 +43,103 @@ _m_sent = telemetry.counter(
 _m_recv = telemetry.counter(
     "p2p_msgs_recv_total", "Messages received from peers, by channel",
     ("channel",))
+_m_bans = telemetry.counter(
+    "p2p_bans_total", "Peers banned for falling below the trust "
+    "score threshold")
+_m_unbans = telemetry.counter(
+    "p2p_unbans_total", "Ban expiries observed (peer re-admittable)")
+_m_banned = telemetry.gauge(
+    "p2p_banned_peers", "Peer ids currently under a ban")
+_m_shed = telemetry.counter(
+    "p2p_accept_shed_total", "Inbound conns shed at the accept path, "
+    "by reason", ("reason",))
+_m_peer_errors = telemetry.counter(
+    "p2p_peer_errors_total", "Peers stopped for an error, by class "
+    "(protocol = invalid frames/messages, network = transport)",
+    ("kind",))
+_m_hs_fail = telemetry.counter(
+    "p2p_handshake_failures_total", "Handshakes aborted, by reason",
+    ("reason",))
 
 RECONNECT_ATTEMPTS = 20
 RECONNECT_BASE_S = 1.0          # exponential backoff base (switch.go:26-33)
 RECONNECT_MULTIPLIER = 2.0
 RECONNECT_MAX_S = 300.0
+
+# Trust scoring weights (ISSUE 13): a protocol violation (corrupt or
+# malformed frame, unknown channel/packet, oversized message) is worth
+# this many bad events — a transport error stays at 1. Clean traffic
+# scores one good event per CLEAN_MSGS_PER_GOOD routed messages, so a
+# long-lived honest peer's current interval carries enough good weight
+# that one bad burst cannot drop it under the ban threshold (the
+# pre-ISSUE asymmetry: good only ever scored on add_peer).
+PROTOCOL_BAD_WEIGHT = 10.0
+CLEAN_MSGS_PER_GOOD = 64
+#: strikes decay one step per this many ban-base seconds of clean time
+BAN_STRIKE_DECAY_MULT = 4.0
+_BAN_MAX_DOUBLINGS = 6
+
+_protocol_error_types: Optional[tuple] = None
+
+
+def _protocol_error(err) -> bool:
+    """A peer error that means MALFORMED INPUT (score it hard), as
+    opposed to a transport failure (score it lightly): codec
+    ValueErrors, AEAD authentication failures from any backend."""
+    global _protocol_error_types
+    if _protocol_error_types is None:
+        from tendermint_tpu.native import AeadTagError
+        from tendermint_tpu.p2p.conn import purecrypto
+        kinds = [ValueError, AeadTagError, purecrypto.InvalidTag]
+        try:
+            from cryptography.exceptions import InvalidTag
+            kinds.append(InvalidTag)
+        except ImportError:
+            pass
+        _protocol_error_types = tuple(kinds)
+    return isinstance(err, _protocol_error_types)
+
+
+def _redial_jitter(key: str, attempt: int) -> float:
+    """Deterministic backoff jitter in [0.5, 1.0): the same (address,
+    attempt) always waits the same time, so a chaos replay reproduces
+    the redial schedule exactly (random.random() here made every
+    reconnect trace unreproducible)."""
+    h = zlib.crc32(f"{key}#{attempt}".encode())
+    return 0.5 + (h % 4096) / 8192.0
+
+
+class _DeadlineSock:
+    """Handshake-only socket wrapper enforcing a TOTAL deadline. The
+    per-read settimeout alone lets a slow-loris peer trickle one byte
+    per interval forever; here every op re-derives its timeout from the
+    one deadline, so the whole handshake is bounded no matter how the
+    bytes are paced. After the handshake the link is handed the raw
+    socket back — this wrapper polices setup only."""
+
+    def __init__(self, sock: socket.socket, deadline: float):
+        self.sock = sock
+        self.deadline = deadline
+
+    def _arm(self) -> None:
+        remaining = self.deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("handshake deadline exceeded")
+        self.sock.settimeout(remaining)
+
+    def recv(self, n: int) -> bytes:
+        self._arm()
+        return self.sock.recv(n)
+
+    def sendall(self, data: bytes) -> None:
+        self._arm()
+        self.sock.sendall(data)
+
+    def shutdown(self, how) -> None:
+        self.sock.shutdown(how)
+
+    def close(self) -> None:
+        self.sock.close()
 
 
 def dial_tiebreak_keep_new(self_id: str, their_id: str,
@@ -101,8 +196,89 @@ class Switch:
         self.id_filters: List[Callable[[str], None]] = []
         # addr book hook (set by the PEX reactor)
         self.addr_book = None
-        # optional TrustMetricStore: good on handshake, bad on error-stop
+        # optional TrustMetricStore: good on handshake + per
+        # CLEAN_MSGS_PER_GOOD routed messages, bad (weighted) on
+        # error-stop — and ENFORCED (ISSUE 13): a peer whose trust
+        # score falls under ban_score is refused at the handshake until
+        # its ban decays (repeat offenders' bans double, strikes decay
+        # with clean time)
         self.trust_store = None
+        self.banned: Dict[str, dict] = {}   #: guarded_by _lock
+        self._ban_score = knobs.knob_int(
+            "TM_TPU_P2P_BAN_SCORE",
+            config=getattr(config, "ban_score", None), default=30)
+        self._ban_base_s = knobs.knob_float(
+            "TM_TPU_P2P_BAN_BASE_S",
+            config=getattr(config, "ban_base_s", None), default=60.0)
+        self._fd_headroom = knobs.knob_int(
+            "TM_TPU_P2P_FD_HEADROOM",
+            config=getattr(config, "fd_headroom", None), default=64)
+
+    # ------------------------------------------------------------ ban plane
+
+    def ban_peer(self, peer_id: str, reason: str = "") -> None:
+        """Ban with decaying escalation: first offense = ban_base_s,
+        each repeat doubles (capped at 2^6), and strikes decay one step
+        per BAN_STRIKE_DECAY_MULT * ban_base_s of clean time — a
+        repeat offender's bans grow, a peer that stays clean earns its
+        way back to first-offense treatment. Strike history survives
+        the unban (else every ban would read as a first offense)."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self.banned.get(peer_id)
+            strikes = 1
+            if rec is not None:
+                decayed = int((now - rec["last"]) /
+                              (self._ban_base_s * BAN_STRIKE_DECAY_MULT))
+                strikes = max(0, rec["strikes"] - decayed) + 1
+            duration = self._ban_base_s * (
+                2 ** min(strikes - 1, _BAN_MAX_DOUBLINGS))
+            if len(self.banned) > 1024 and peer_id not in self.banned:
+                # bounded memory under an id-churning flood: drop the
+                # stalest strike record, never an ACTIVE ban
+                stale = [pid for pid, r in self.banned.items()
+                         if not r["active"]]
+                if stale:
+                    del self.banned[min(
+                        stale, key=lambda p: self.banned[p]["last"])]
+            self.banned[peer_id] = {"until": now + duration,
+                                    "strikes": strikes, "last": now,
+                                    "active": True}
+            n_banned = sum(1 for r in self.banned.values()
+                           if r["active"])
+        _m_bans.inc()
+        _m_banned.set(n_banned)
+        self.logger.error("peer banned", peer=peer_id[:16],
+                          strikes=strikes, seconds=round(duration, 1),
+                          reason=reason)
+
+    def is_banned(self, peer_id: str) -> bool:
+        """Ban check with lazy expiry: an expired ban flips inactive
+        (counted as an unban) the first time anyone asks; the strike
+        record stays behind for the escalation math."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self.banned.get(peer_id)
+            if rec is None or (not rec["active"] and
+                               now >= rec["until"]):
+                return False
+            if now < rec["until"]:
+                return True
+            rec["active"] = False
+            n_banned = sum(1 for r in self.banned.values()
+                           if r["active"])
+        _m_unbans.inc()
+        _m_banned.set(n_banned)
+        self.logger.info("peer ban expired", peer=peer_id[:16])
+        return False
+
+    def _maybe_ban(self, peer_id: str) -> None:
+        if self.trust_store is None or self._ban_score <= 0:
+            return
+        score = self.trust_store.get_metric(peer_id).trust_score()
+        if score < self._ban_score:
+            self.ban_peer(peer_id, reason=f"trust score {score} < "
+                                          f"{self._ban_score}")
 
     # ------------------------------------------------------------- reactors
 
@@ -210,11 +386,35 @@ class Switch:
                 time.sleep(0.1)
                 continue
             if self.peers.size() >= getattr(self.config, "max_num_peers", 50):
+                _m_shed.labels("peers").inc()
+                sock.close()
+                continue
+            if not self._fd_headroom_ok():
+                # admission shedding: accepting would spend fds the
+                # node needs for its own stores/peers — refuse loudly
+                # at the door instead of failing opaquely mid-run
+                _m_shed.labels("fd").inc()
                 sock.close()
                 continue
             threading.Thread(
                 target=self._handle_inbound, args=(sock, addrinfo),
                 daemon=True).start()
+
+    def _fd_budget(self) -> tuple:
+        """(soft fd limit, open fds) — (0, 0) when unknowable (non-
+        Linux without /proc): headroom checks then pass."""
+        try:
+            import resource
+            soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+            return soft, len(os.listdir("/proc/self/fd"))
+        except (OSError, ValueError, ImportError):
+            return 0, 0
+
+    def _fd_headroom_ok(self) -> bool:
+        soft, n_open = self._fd_budget()
+        if soft <= 0:
+            return True
+        return soft - n_open >= self._fd_headroom
 
     def _handle_inbound(self, sock: socket.socket, addrinfo) -> None:
         try:
@@ -270,12 +470,23 @@ class Switch:
         addPeer)."""
         link = None
         try:
-            sock.settimeout(getattr(self.config, "handshake_timeout_s", 20.0))
+            # TOTAL handshake deadline (ISSUE 13): settimeout alone is
+            # a per-read budget a slow-loris peer never trips; the
+            # wrapper re-derives every op's timeout from one deadline
+            hs_deadline = time.monotonic() + getattr(
+                self.config, "handshake_timeout_s", 20.0)
+            dsock = _DeadlineSock(sock, hs_deadline)
             if self.encrypt:
-                link = SecretConnection.make(sock, self.node_key)
+                link = SecretConnection.make(dsock, self.node_key)
                 remote_id = pubkey_to_id(link.remote_pubkey)
+                # ban enforcement at the earliest moment identity is
+                # AUTHENTICATED — before we spend NodeInfo parsing (or
+                # reactor wiring) on a known-hostile peer
+                if self.is_banned(remote_id):
+                    _m_hs_fail.labels("banned").inc()
+                    raise SwitchError(f"peer {remote_id} is banned")
             else:
-                link = PlainFramedConn(sock)
+                link = PlainFramedConn(dsock)
                 remote_id = None
 
             write_handshake_msg(link,
@@ -294,13 +505,29 @@ class Switch:
                     f"dialed {dial_addr.id} but got {their_info.id}")
             if their_info.id == self.node_info.id:
                 raise SwitchError("self-connection rejected")
+            if remote_id is None and self.is_banned(their_info.id):
+                # plaintext links authenticate nothing; the claimed id
+                # is still enforced so a banned peer cannot reconnect
+                _m_hs_fail.labels("banned").inc()
+                raise SwitchError(f"peer {their_info.id} is banned")
             for f in self.id_filters:
                 f(their_info.id)
             self.node_info.compatible_with(their_info)
+        except socket.timeout:
+            _m_hs_fail.labels("deadline").inc()
+            if link is not None:
+                link.close()
+            else:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise
         except Exception:
             # every handshake failure must release the socket — the dial
             # path retries with backoff and would otherwise leak one FD
             # per attempt
+            _m_hs_fail.labels("error").inc()
             if link is not None:
                 link.close()
             else:
@@ -310,6 +537,10 @@ class Switch:
                     pass
             raise
 
+        # handshake done: the link runs on the RAW socket from here (the
+        # loop plane needs the real fd; the deadline wrapper polices
+        # setup only)
+        link.conn = sock
         sock.settimeout(None)
         # chaos plane: schedule-driven lossy-link wrapper, or — the
         # default, TM_TPU_CHAOS=off — the link back unchanged, keeping
@@ -385,6 +616,13 @@ class Switch:
                 peer, ValueError(f"msg on unknown channel {ch_id:#x}"))
             return
         _m_recv.labels(f"{ch_id:#04x}").inc()
+        if self.trust_store is not None and \
+                peer.note_clean_msg(CLEAN_MSGS_PER_GOOD):
+            # steady-state good scoring (ISSUE 13 satellite): before
+            # this, good only scored once at add_peer while bad fired
+            # per recv error — a long-lived honest peer could be banned
+            # by one bad burst because its interval held 1 good event
+            self.trust_store.get_metric(peer.id).good_events(1)
         reactor.receive(ch_id, peer, msg)
 
     def _peer_error(self, peer: Peer, err: Exception) -> None:
@@ -402,8 +640,16 @@ class Switch:
             # replaced — would smear well-behaved peers
             self.logger.error("stopping peer for error", peer=peer.id,
                               err=reason)
+            protocol = _protocol_error(reason)
+            _m_peer_errors.labels(
+                "protocol" if protocol else "network").inc()
             if self.trust_store is not None:
-                self.trust_store.get_metric(peer.id).bad_events(1)
+                # invalid frames/messages score much harder than
+                # transport flakes — and the score is ENFORCED: under
+                # the threshold the peer is banned until the ban decays
+                self.trust_store.get_metric(peer.id).bad_events(
+                    PROTOCOL_BAD_WEIGHT if protocol else 1.0)
+                self._maybe_ban(peer.id)
         self._remove_peer(peer, reason)
         if peer.persistent and peer.dial_addr is not None and \
                 not stale and \
@@ -457,7 +703,11 @@ class Switch:
         return False
 
     def _reconnect_to_peer(self, addr: NetAddress) -> None:
-        """Exponential backoff redial (switch.go:279-330)."""
+        """Exponential backoff redial (switch.go:279-330) with
+        DETERMINISTIC jitter: the wait for (address, attempt) is a pure
+        function of both, and the wait clock is utils/clock so chaos
+        skew/replay reproduce the redial schedule. The wait is sliced
+        so Switch.stop() never blocks behind a long backoff."""
         key = str(addr)
         with self._lock:
             if key in self.reconnecting:
@@ -473,8 +723,12 @@ class Switch:
                 except Exception:
                     backoff = min(
                         RECONNECT_MAX_S,
-                        RECONNECT_BASE_S * (RECONNECT_MULTIPLIER ** attempt))
-                    time.sleep(backoff * (0.5 + random.random() / 2))
+                        RECONNECT_BASE_S *
+                        (RECONNECT_MULTIPLIER ** attempt)) * \
+                        _redial_jitter(key, attempt)
+                    deadline = clock.now_s() + backoff
+                    while not self._stopped and clock.now_s() < deadline:
+                        time.sleep(min(0.1, backoff))
         finally:
             with self._lock:
                 self.reconnecting.discard(key)

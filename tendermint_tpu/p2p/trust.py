@@ -22,13 +22,19 @@ DEFAULT_INTERVAL_S = 30.0
 
 class TrustMetric:
     def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
-                 history: Optional[List[float]] = None):
+                 history: Optional[List[float]] = None,
+                 now_fn=time.monotonic):
         self.interval_s = interval_s
+        # injectable interval clock: rollover math is untestable
+        # against the real monotonic clock (a test would sleep
+        # interval_s per assertion), and chaos replays need the
+        # interval boundary to follow their driven clock
+        self._now = now_fn
         self._lock = threading.Lock()
         self.good = 0.0
         self.bad = 0.0
         self.history: List[float] = list(history or [])  # newest first
-        self._interval_start = time.monotonic()
+        self._interval_start = self._now()
 
     # ------------------------------------------------------------- events
 
@@ -43,7 +49,7 @@ class TrustMetric:
             self.bad += n
 
     def _roll_if_due(self) -> None:
-        now = time.monotonic()
+        now = self._now()
         while now - self._interval_start >= self.interval_s:
             self._roll()
             self._interval_start += self.interval_s

@@ -94,6 +94,27 @@ CATALOG: tuple[Knob, ...] = (
     Knob("TM_TPU_P2P_BURST", "spec", "auto", "base.p2p_burst",
          "Burst frame plane: off|on|auto|<max packets per burst>.",
          "p2p/conn/burst.py"),
+    Knob("TM_TPU_P2P_FLUSH_LINGER_MS", "float", "4.0", "",
+         "Loop-mode send-burst rate limiter: an idle conn's send "
+         "flushes immediately, but after a flush the next waits out "
+         "this window so sustained gossip seals full bursts; 0 "
+         "restores flush-per-wakeup (PR 12 behavior).",
+         "p2p/conn/loop.py"),
+    # -- hostile-peer hardening --------------------------------------------
+    Knob("TM_TPU_P2P_BAN_SCORE", "int", "30", "p2p.ban_score",
+         "Trust-score ban threshold: a peer scoring below this after a "
+         "bad event is banned until the ban decays; 0 disables "
+         "enforcement (scores still recorded).",
+         "p2p/switch.py"),
+    Knob("TM_TPU_P2P_BAN_BASE_S", "float", "60.0", "p2p.ban_base_s",
+         "First-offense ban duration, seconds; repeat offenses double "
+         "it (capped at 64x) and strikes decay with clean time.",
+         "p2p/switch.py"),
+    Knob("TM_TPU_P2P_FD_HEADROOM", "int", "64", "p2p.fd_headroom",
+         "Accept-path admission shedding: inbound conns are refused "
+         "while fewer than this many fds remain under the process "
+         "RLIMIT_NOFILE.",
+         "p2p/switch.py"),
     # -- async reactor core ------------------------------------------------
     Knob("TM_TPU_REACTOR", "str", "auto (= loop)", "base.reactor",
          "Socket plane: loop runs every peer socket, gossip routine and "

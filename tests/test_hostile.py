@@ -1,0 +1,243 @@
+"""Hostile-peer hardening (ISSUE 13): total handshake deadline,
+weighted trust scoring + ban enforcement with decaying unban, clean-
+traffic scoring (the trust asymmetry fix), fd-headroom admission
+shedding, and deterministic redial jitter."""
+
+import time
+
+from tendermint_tpu.chaos import hostile
+from tendermint_tpu.p2p.switch import (
+    CLEAN_MSGS_PER_GOOD,
+    PROTOCOL_BAD_WEIGHT,
+    _protocol_error,
+    _redial_jitter,
+)
+from tendermint_tpu.p2p.test_util import connect_switches, make_switch
+from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+from tendermint_tpu.storage import MemDB
+
+from tests.test_p2p import EchoReactor
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def make_victim(ban_base_s=1.0, handshake_timeout_s=1.5):
+    sw = make_switch(network="hostile-net", seed=b"\x31" * 32,
+                     encrypt=True)
+    sw.trust_store = TrustMetricStore(MemDB())
+    sw._ban_base_s = ban_base_s
+    sw.config.handshake_timeout_s = handshake_timeout_s
+    return sw
+
+
+# ------------------------------------------------------ handshake deadline
+
+
+def test_handshake_stall_killed_by_total_deadline():
+    sw = make_victim()
+    addr = sw.listen("127.0.0.1", 0)
+    try:
+        r = hostile.run_hostile("handshake_stall", "127.0.0.1",
+                                addr.port, budget_s=6.0)
+        assert r["defense_fired"], r
+        assert r["closed_by_victim_s"] < 4.0
+    finally:
+        sw.stop()
+
+
+def test_slow_loris_handshake_killed_despite_per_read_progress():
+    """One byte per 0.3s never trips a per-read timeout — only the
+    TOTAL deadline disconnects this peer."""
+    sw = make_victim(handshake_timeout_s=1.2)
+    addr = sw.listen("127.0.0.1", 0)
+    try:
+        r = hostile.run_hostile("slow_handshake", "127.0.0.1",
+                                addr.port, byte_interval_s=0.3,
+                                budget_s=8.0)
+        assert r["defense_fired"], r
+        assert 2 <= r["bytes_sent"] < 32  # progressing, yet killed
+    finally:
+        sw.stop()
+
+
+# ------------------------------------------------------------- ban plane
+
+
+def test_garbage_peer_banned_then_readmitted_after_decay():
+    """The full lifecycle from one hostile identity: authed -> garbage
+    -> weighted bad score -> BAN (handshake refused) -> ban expiry ->
+    re-admission. The trust plane now enforces, not just records."""
+    sw = make_victim(ban_base_s=1.0)
+    addr = sw.listen("127.0.0.1", 0)
+    try:
+        r = hostile.run_hostile(
+            "garbage_after_auth", "127.0.0.1", addr.port,
+            network="hostile-net", channels=[], rounds=9,
+            retry_gap_s=0.25, budget_s=20.0)
+        kinds = [o["outcome"] for o in r["rounds"]]
+        assert r["saw_ban"], kinds
+        assert r["readmitted_after_ban"], kinds
+        # the ban plane recorded the offender
+        assert sw.trust_store.get_metric(r["peer_id"]).trust_score() < 30
+        with sw._lock:
+            assert r["peer_id"] in sw.banned
+    finally:
+        sw.stop()
+
+
+def test_oversize_frame_killed_and_scored():
+    sw = make_victim()
+    addr = sw.listen("127.0.0.1", 0)
+    try:
+        r = hostile.run_hostile("oversize_frame", "127.0.0.1",
+                                addr.port, network="hostile-net",
+                                channels=[])
+        assert r["outcome"] == "authed"
+        assert r["defense_fired"], r
+    finally:
+        sw.stop()
+
+
+def test_ban_duration_doubles_and_strikes_decay():
+    sw = make_victim(ban_base_s=0.2)
+    try:
+        sw.ban_peer("p1")
+        with sw._lock:
+            first = dict(sw.banned["p1"])
+        assert first["strikes"] == 1
+        sw.ban_peer("p1")          # immediate repeat: escalation
+        with sw._lock:
+            second = dict(sw.banned["p1"])
+        assert second["strikes"] == 2
+        assert second["until"] - second["last"] > \
+            (first["until"] - first["last"]) * 1.5
+        time.sleep(1.7)            # > 2 decay steps (0.8s each)
+        sw.ban_peer("p1")
+        with sw._lock:
+            third = dict(sw.banned["p1"])
+        assert third["strikes"] == 1  # clean time earned decay back
+    finally:
+        sw.stop()
+
+
+def test_is_banned_lazy_expiry_keeps_strike_history():
+    sw = make_victim(ban_base_s=0.1)
+    try:
+        sw.ban_peer("p2")
+        assert sw.is_banned("p2")
+        assert wait_for(lambda: not sw.is_banned("p2"), timeout=3.0)
+        with sw._lock:
+            assert sw.banned["p2"]["strikes"] == 1  # history survives
+            assert not sw.banned["p2"]["active"]
+    finally:
+        sw.stop()
+
+
+def test_ban_disabled_at_zero_score_threshold():
+    sw = make_victim()
+    sw._ban_score = 0
+    try:
+        sw.trust_store.get_metric("p3").bad_events(1000)
+        sw._maybe_ban("p3")
+        with sw._lock:
+            assert "p3" not in sw.banned
+    finally:
+        sw.stop()
+
+
+# ------------------------------------------------ trust scoring asymmetry
+
+
+def test_protocol_errors_classified_and_weighted():
+    from tendermint_tpu.native import AeadTagError
+    from tendermint_tpu.p2p.conn import purecrypto
+    assert _protocol_error(ValueError("oversized secret frame"))
+    assert _protocol_error(AeadTagError("tag"))
+    assert _protocol_error(purecrypto.InvalidTag("tag"))
+    assert not _protocol_error(ConnectionError("reset"))
+    assert not _protocol_error(OSError(104, "reset"))
+
+
+def test_long_lived_honest_peer_survives_one_bad_burst():
+    """The satellite fix in numbers: with steady-state good scoring, a
+    peer that routed ~1000 clean messages keeps its score ABOVE the
+    ban threshold through one protocol-weighted bad event. Without it
+    (good = the single add_peer credit) the same burst bans it."""
+    with_traffic = TrustMetric()
+    with_traffic.good_events(1 + 1000 / CLEAN_MSGS_PER_GOOD)
+    with_traffic.bad_events(PROTOCOL_BAD_WEIGHT)
+    assert with_traffic.trust_score() >= 30
+
+    pre_fix = TrustMetric()
+    pre_fix.good_events(1)            # add_peer only — the old plane
+    pre_fix.bad_events(PROTOCOL_BAD_WEIGHT)
+    assert pre_fix.trust_score() < 30
+
+
+def test_clean_traffic_scores_good_events_through_route():
+    r1 = EchoReactor("echo", 0x10, echo=False)
+    r2 = EchoReactor("echo", 0x10, echo=False)
+    sw1 = make_switch(seed=b"\x33" * 32)
+    sw2 = make_switch(seed=b"\x34" * 32)
+    sw2.trust_store = TrustMetricStore(MemDB())
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start()
+    sw2.start()
+    try:
+        p1, _ = connect_switches(sw1, sw2)
+        metric = sw2.trust_store.get_metric(sw1.node_info.id)
+        base = metric.good
+        for i in range(CLEAN_MSGS_PER_GOOD * 2):
+            assert p1.send(0x10, b"m%d" % i)
+        assert wait_for(
+            lambda: len(r2.received) >= CLEAN_MSGS_PER_GOOD * 2)
+        assert wait_for(lambda: metric.good >= base + 2)
+    finally:
+        sw1.stop()
+        sw2.stop()
+
+
+# --------------------------------------------------- admission + redial
+
+
+def test_fd_headroom_sheds_inbound_accepts():
+    sw = make_victim()
+    # simulate scarcity: 90 of 100 fds in use, headroom demands 64
+    sw._fd_budget = lambda: (100, 90)
+    addr = sw.listen("127.0.0.1", 0)
+    try:
+        import socket as _socket
+        c = _socket.create_connection(("127.0.0.1", addr.port),
+                                      timeout=3.0)
+        c.settimeout(3.0)
+        assert c.recv(1) == b""   # shed: closed without a handshake
+        c.close()
+        assert sw.peers.size() == 0
+    finally:
+        sw.stop()
+
+
+def test_fd_headroom_unknowable_passes():
+    sw = make_victim()
+    sw._fd_budget = lambda: (0, 0)
+    assert sw._fd_headroom_ok()
+    sw.stop()
+
+
+def test_redial_jitter_is_deterministic_and_bounded():
+    vals = set()
+    for attempt in range(12):
+        j = _redial_jitter("id@127.0.0.1:1234", attempt)
+        assert j == _redial_jitter("id@127.0.0.1:1234", attempt)
+        assert 0.5 <= j < 1.0
+        vals.add(j)
+    assert len(vals) > 6            # attempts actually spread
+    assert _redial_jitter("a", 0) != _redial_jitter("b", 0)

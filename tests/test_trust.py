@@ -1,0 +1,157 @@
+"""p2p/trust.py — rollover/decay math + store persistence (ISSUE 13
+satellite: the trust plane gained enforcement, so its scoring math is
+now load-bearing and needs direct coverage)."""
+
+import math
+
+from tendermint_tpu.p2p.trust import (
+    INTEGRAL_WEIGHT,
+    MAX_HISTORY,
+    PROPORTIONAL_WEIGHT,
+    TrustMetric,
+    TrustMetricStore,
+)
+from tendermint_tpu.storage import MemDB
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_metric(interval_s=10.0, history=None):
+    clk = FakeClock()
+    return TrustMetric(interval_s=interval_s, history=history,
+                       now_fn=clk), clk
+
+
+# ------------------------------------------------------------- rollover
+
+
+def test_roll_closes_interval_into_history_newest_first():
+    m, clk = make_metric()
+    m.good_events(3)
+    m.bad_events(1)
+    clk.advance(10.0)
+    m.good_events(1)          # triggers the roll of the prior interval
+    assert m.history == [0.75]
+    # events after the roll belong to the fresh interval
+    assert (m.good, m.bad) == (1.0, 0.0)
+    clk.advance(10.0)
+    m.bad_events(1)
+    assert m.history == [1.0, 0.75]  # newest first
+
+
+def test_roll_covers_multiple_elapsed_intervals():
+    m, clk = make_metric()
+    m.bad_events(1)
+    clk.advance(35.0)          # 3 full intervals elapsed
+    m.good_events(1)
+    # interval 1 rolled its 0.0 ratio; the two EMPTY elapsed intervals
+    # rolled the benefit-of-the-doubt 1.0
+    assert m.history == [1.0, 1.0, 0.0]
+
+
+def test_history_bounded_at_max():
+    m, clk = make_metric()
+    for i in range(MAX_HISTORY + 5):
+        m.good_events(1)
+        clk.advance(10.0)
+    m.good_events(1)
+    assert len(m.history) == MAX_HISTORY
+
+
+def test_history_value_fades_with_inverse_sqrt_age():
+    m, _ = make_metric(history=[0.0, 1.0, 1.0])
+    w = [1.0 / math.sqrt(i + 1) for i in range(3)]
+    expected = (0.0 * w[0] + 1.0 * w[1] + 1.0 * w[2]) / sum(w)
+    assert abs(m._history_value() - expected) < 1e-12
+    # the same ratios with the bad interval OLDEST score higher: age
+    # fades influence
+    m2, _ = make_metric(history=[1.0, 1.0, 0.0])
+    assert m2._history_value() > m._history_value()
+
+
+# ----------------------------------------------------------- trust_value
+
+
+def test_trust_value_downswing_penalty_only_punishes_drops():
+    # falling ratio: current interval much worse than history
+    falling, _ = make_metric(history=[1.0] * 4)
+    falling.good_events(1)
+    falling.bad_events(9)
+    r, h = 0.1, 1.0
+    d = (r - h) * PROPORTIONAL_WEIGHT
+    expected = PROPORTIONAL_WEIGHT * r + INTEGRAL_WEIGHT * h + d
+    assert abs(falling.trust_value() - expected) < 1e-12
+
+    # rising ratio: no derivative bonus, just the weighted sum
+    rising, _ = make_metric(history=[0.5] * 4)
+    rising.good_events(10)
+    expected_rising = PROPORTIONAL_WEIGHT * 1.0 + INTEGRAL_WEIGHT * 0.5
+    assert abs(rising.trust_value() - expected_rising) < 1e-12
+
+
+def test_trust_value_clamped_to_unit_interval():
+    m, _ = make_metric(history=[0.0] * MAX_HISTORY)
+    m.bad_events(100)
+    assert m.trust_value() == 0.0
+    fresh, _ = make_metric()
+    fresh.good_events(100)
+    assert fresh.trust_value() == 1.0
+    assert fresh.trust_score() == 100
+
+
+def test_trust_score_floor_without_history_is_twenty():
+    """With an empty history the integral term's benefit of the doubt
+    floors the score at 20 — the reason the ban threshold defaults
+    ABOVE 20 (a fresh peer's first garbage burst must be bannable)."""
+    m, _ = make_metric()
+    m.bad_events(1000)
+    assert m.trust_score() == 20
+
+
+# ------------------------------------------------------------ persistence
+
+
+def test_to_obj_folds_open_interval_only_when_it_saw_events():
+    m, _ = make_metric(history=[0.5])
+    m.good_events(1)
+    m.bad_events(1)
+    assert TrustMetric.from_obj(m.to_obj()).history == [0.5, 0.5]
+    # an EMPTY open interval must not launder a synthetic 1.0 in
+    empty, _ = make_metric(history=[0.25])
+    assert TrustMetric.from_obj(empty.to_obj()).history == [0.25]
+
+
+def test_store_round_trip_preserves_per_peer_history():
+    db = MemDB()
+    store = TrustMetricStore(db, interval_s=10.0)
+    a = store.get_metric("peer-a")
+    a.good_events(3)
+    a.bad_events(1)
+    store.get_metric("peer-b").bad_events(2)
+    store.save()
+
+    loaded = TrustMetricStore(db, interval_s=10.0)
+    ra = loaded.get_metric("peer-a")
+    rb = loaded.get_metric("peer-b")
+    assert ra.history == [0.75]       # open interval folded on save
+    assert rb.history == [0.0]
+    assert ra.interval_s == 10.0
+    # unknown peers start fresh, not poisoned by neighbors
+    assert loaded.get_metric("peer-c").history == []
+
+
+def test_store_disconnect_persists():
+    db = MemDB()
+    store = TrustMetricStore(db)
+    store.get_metric("p").bad_events(4)
+    store.peer_disconnected("p")
+    assert TrustMetricStore(db).get_metric("p").history == [0.0]

@@ -1,0 +1,375 @@
+"""Wire-level chaos plane (ISSUE 13): WireSchedule determinism, the
+TCP fault proxy against real switches, graceful degradation of the
+codec + loop plane under corruption at every codec state, and the
+RPC-polling SocketInvariantMonitor's verdict logic."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.chaos.wire import (
+    SocketInvariantMonitor,
+    WireProxy,
+    WireSchedule,
+)
+from tendermint_tpu.p2p import NetAddress
+from tendermint_tpu.p2p.test_util import make_switch
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+SPEC = {
+    "drop": 0.01, "delay": 0.2, "delay_steps": [1, 4],
+    "corrupt": 0.005,
+    "partitions": [{"start": 10, "stop": 30, "groups": [[0], [1, 2, 3]]}],
+    "stalls": [{"start": 40, "stop": 50, "links": [[0, 1]]}],
+    "resets": [{"at": 60, "links": [[1, 2]]}],
+    "reset_every_steps": 100,
+    "geo": {"profile": "wan2"},
+    "step_ms": 20,
+}
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_same_spec_seed_gives_byte_identical_plan_and_streams():
+    a = WireSchedule(SPEC, seed=42, n_nodes=4)
+    b = WireSchedule(SPEC, seed=42, n_nodes=4)
+    assert a.plan == b.plan
+    assert a.plan_digest() == b.plan_digest()
+    for i in range(4):
+        for j in range(4):
+            if i == j:
+                continue
+            assert a.link_stream(i, j, 0).digest(300) == \
+                b.link_stream(i, j, 0).digest(300)
+
+
+def test_seed_link_and_conn_change_the_streams():
+    a = WireSchedule(SPEC, seed=42, n_nodes=4)
+    other_seed = WireSchedule(SPEC, seed=43, n_nodes=4)
+    assert a.plan_digest() != other_seed.plan_digest()
+    base = a.link_stream(0, 1, 0).digest(300)
+    assert a.link_stream(1, 0, 0).digest(300) != base   # direction
+    assert a.link_stream(0, 2, 0).digest(300) != base   # link
+    assert a.link_stream(0, 1, 1).digest(300) != base   # conn index
+    assert other_seed.link_stream(0, 1, 0).digest(300) != base
+
+
+def test_decision_stream_is_frame_indexed_and_aligned():
+    """Every frame draws the same number of RNG values regardless of
+    outcome, so decision k is a pure function of (seed, link, conn, k)
+    — the alignment the byte-identical-log contract rests on."""
+    a = WireSchedule(SPEC, seed=7, n_nodes=4).link_stream(0, 1, 0)
+    b = WireSchedule(SPEC, seed=7, n_nodes=4).link_stream(0, 1, 0)
+    decs_a = [a.decide() for _ in range(200)]
+    decs_b = [b.decide() for _ in range(200)]
+    assert decs_a == decs_b
+    assert [d["frame"] for d in decs_a] == list(range(200))
+
+
+def test_spec_validation_is_loud():
+    with pytest.raises(ValueError, match="unknown wire spec key"):
+        WireSchedule({"dorp": 0.1})
+    with pytest.raises(ValueError, match="unknown geo profile"):
+        WireSchedule({"geo": {"profile": "wan9"}})
+
+
+def test_geo_latency_rides_every_frame():
+    sched = WireSchedule({"geo": {"profile": "wan2"}, "step_ms": 100},
+                         seed=1, n_nodes=2)
+    # wan2 cross-region latency is 4 steps; nodes 0/1 round-robin into
+    # regions 0/1, so every 0->1 frame carries >= 0.4s
+    st = sched.link_stream(0, 1, 0)
+    for _ in range(50):
+        assert st.decide()["delay_s"] >= 0.4
+    # no geo => no added latency
+    flat = WireSchedule({}, seed=1, n_nodes=2).link_stream(0, 1, 0)
+    assert all(flat.decide()["delay_s"] == 0.0 for _ in range(50))
+
+
+def test_blocked_windows_follow_the_plan():
+    sched = WireSchedule(SPEC, seed=3, n_nodes=4)
+    assert sched.blocked(15, 0, 1) == "partition"
+    assert sched.blocked(15, 1, 2) is None      # same group
+    assert sched.blocked(35, 0, 1) is None      # healed
+    assert sched.blocked(45, 0, 1) == "stall"
+    assert sched.blocked(45, 1, 0) is None      # stall is directed
+    assert (60, (1, 2)) in sched.resets()
+
+
+# ------------------------------------------- corruption: every codec state
+
+
+def _secret_pair():
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.p2p.conn import SecretConnection
+    from tendermint_tpu.types.keys import PrivKey
+    s1, s2 = socket.socketpair()
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault(
+        "a", SecretConnection.make(s1, NodeKey(PrivKey.generate(
+            b"\x01" * 32)))))
+    t.start()
+    out["b"] = SecretConnection.make(
+        s2, NodeKey(PrivKey.generate(b"\x02" * 32)))
+    t.join(10)
+    return out["a"], out["b"], s1, s2
+
+
+def _protocol_errors():
+    from tendermint_tpu.native import AeadTagError
+    from tendermint_tpu.p2p.conn import purecrypto
+    kinds = [ValueError, AeadTagError, purecrypto.InvalidTag]
+    try:
+        from cryptography.exceptions import InvalidTag
+        kinds.append(InvalidTag)
+    except ImportError:
+        pass
+    return tuple(kinds)
+
+
+def test_feed_wire_corruption_sweep_every_byte_class_raises_cleanly():
+    """A corrupted or unparseable frame must raise a classifiable
+    protocol error from feed_wire — at EVERY codec state: length
+    prefix (oversize immediately; an underflowing prefix once the
+    bytes that follow complete the bogus frame, as on a live stream),
+    frame header, payload and tag bytes, and a flip landing in the
+    second frame of a burst. Never a hang, never a non-exception
+    crash."""
+    kinds = _protocol_errors()
+    a, b, s1, s2 = _secret_pair()
+    wire = a.seal_frames([b"frame-one-payload", b"frame-two"])
+    # byte classes: 0-3 length prefix, 4-6 sealed header region, mid
+    # payload, last byte (tag), and a flip inside the SECOND frame
+    (l1,) = struct.unpack(">I", wire[:4])
+    for pos in (0, 1, 3, 4, 6, 4 + l1 // 2, 4 + l1 - 1, 4 + l1 + 2):
+        fresh_a, fresh_b, fs1, fs2 = _secret_pair()
+        clean = fresh_a.seal_frames([b"frame-one-payload",
+                                     b"frame-two"])
+        corrupted = bytearray(clean)
+        corrupted[pos] ^= 0xFF
+        with pytest.raises(kinds):
+            frames = fresh_b.feed_wire(bytes(corrupted))
+            # a prefix that decoded SMALLER than the real frame parses
+            # nothing yet; the stream bytes that keep arriving complete
+            # the bogus frame and the tag check kills it
+            assert frames == []
+            fresh_b.feed_wire(b"\xff" * 4096)
+        for s in (fs1, fs2):
+            s.close()
+    # partial feed then corruption: state machine mid-frame
+    fresh_a, fresh_b, fs1, fs2 = _secret_pair()
+    clean = fresh_a.seal_frames([b"x" * 600])
+    assert fresh_b.feed_wire(clean[:5]) == []   # partial: buffered
+    corrupted = bytearray(clean[5:])
+    corrupted[-1] ^= 0x01
+    with pytest.raises(kinds):
+        fresh_b.feed_wire(bytes(corrupted))
+    for s in (fs1, fs2, s1, s2):
+        s.close()
+
+
+def test_loop_conn_survives_corrupt_frame_with_disconnect_not_crash():
+    """Graceful degradation on the loop plane: garbage on a live conn
+    fires on_error (disconnect) and the LOOP stays alive — other conns
+    and timers keep running."""
+    from tendermint_tpu.p2p.conn.loop import LoopMConnection, ReactorLoop
+    from tendermint_tpu.p2p.conn import ChannelDescriptor
+    from tendermint_tpu.p2p.conn.mconn import PlainFramedConn
+
+    loop = ReactorLoop(name="test-wire-loop")
+    loop.start()
+    try:
+        s1, s2 = socket.socketpair()
+        errors = []
+        conn = LoopMConnection(
+            loop, PlainFramedConn(s1), [ChannelDescriptor(0x10)],
+            on_receive=lambda ch, m: None,
+            on_error=lambda e: errors.append(e))
+        conn.start()
+        # an impossible frame: length prefix far beyond the 1042B cap
+        s2.sendall(struct.pack(">I", 1 << 30) + b"\xff" * 64)
+        assert wait_for(lambda: errors)
+        assert isinstance(errors[0], ValueError)
+        assert wait_for(lambda: not conn.running)
+        # the loop itself is intact: timers still fire
+        fired = threading.Event()
+        loop.call_later(0.01, fired.set)
+        assert fired.wait(2.0)
+        s2.close()
+    finally:
+        loop.stop()
+
+
+# ------------------------------------------------------------- proxy e2e
+
+
+def _proxied_switch_pair(spec, seed=1, ban_score=0):
+    """Two encrypted switches connected THROUGH a WireProxy (node 0
+    dials node 1), persistent so the redial path is live."""
+    sw0 = make_switch(network="wire-net", seed=b"\x21" * 32,
+                      encrypt=True)
+    sw1 = make_switch(network="wire-net", seed=b"\x22" * 32,
+                      encrypt=True)
+    sw0._ban_score = ban_score  # keep trust enforcement out of the way
+    sw1._ban_score = ban_score
+    a1 = sw1.listen("127.0.0.1", 0)
+    sched = WireSchedule(spec, seed=seed, n_nodes=2)
+    proxy = WireProxy(sched, {(0, 1): ("127.0.0.1", a1.port)})
+    ports = proxy.listen()
+    proxy.start()
+    sw0.start()
+    sw1.start()
+    sw0.dial_peer(NetAddress("127.0.0.1", ports[(0, 1)], sw1.node_info.id),
+                  persistent=True)
+    return sw0, sw1, proxy, sched
+
+
+def test_proxy_reset_disconnects_and_persistent_peer_redials():
+    spec = {"resets": [{"at": 0, "links": [[0, 1]]}], "step_ms": 20}
+    sw0, sw1, proxy, sched = _proxied_switch_pair(spec)
+    try:
+        # BOTH ends registered: sw1's inbound add_peer runs async
+        assert wait_for(lambda: sw0.peers.size() == 1 and
+                        sw1.peers.size() == 1)
+        first = sw0.peers.list()[0]
+        proxy.arm()
+        # the reset kills the live conn...
+        assert wait_for(lambda: sw0.peers.get(first.id) is not first or
+                        not first.running, timeout=15.0)
+        # the victim can observe the RST a GIL slice before the proxy
+        # thread books the fault — the count must be waited for too
+        assert wait_for(
+            lambda: sched.applied_counts().get("reset", 0) >= 1)
+        # ...and the persistent dialer re-establishes THROUGH the proxy
+        assert wait_for(
+            lambda: sw0.peers.size() == 1 and
+            sw0.peers.list()[0].running and
+            sw0.peers.list()[0] is not first, timeout=20.0)
+    finally:
+        sw0.stop()
+        sw1.stop()
+        proxy.stop()
+
+
+def test_proxy_corruption_causes_disconnect_not_crash():
+    """corrupt=1.0: the first faulted frame poisons the AEAD stream;
+    the victim must classify + disconnect, and BOTH switches stay
+    functional (the wedge/crash regression the tentpole demands)."""
+    spec = {"corrupt": 1.0, "step_ms": 20}
+    sw0, sw1, proxy, sched = _proxied_switch_pair(spec)
+    try:
+        assert wait_for(lambda: sw0.peers.size() == 1 and
+                        sw1.peers.size() == 1)
+        peer0 = sw0.peers.list()[0]
+        proxy.arm()
+        # force traffic through the armed proxy
+        peer0.try_send(0x01, b"\x01")  # ping channel id unused; raw msg
+        # the corrupted frame must be BOOKED (waited: the victim's
+        # disconnect can outrun the proxy's bookkeeping) and the conn
+        # must die on it
+        assert wait_for(
+            lambda: sched.applied_counts().get("corrupt", 0) >= 1,
+            timeout=15.0)
+        assert wait_for(lambda: sw0.peers.size() == 0 or
+                        sw1.peers.size() == 0, timeout=15.0)
+        # both switches alive: they can still accept fresh work
+        assert sw0.listen_address is None  # never listened — still sane
+        assert sw1.listen_address is not None
+    finally:
+        sw0.stop()
+        sw1.stop()
+        proxy.stop()
+
+
+# ---------------------------------------------------------------- monitor
+
+
+class _FakeClient:
+    """Scripted RPC client: status + blockchain from canned chains."""
+
+    def __init__(self, chain):
+        # chain: height -> (block_hash_hex, app_hash_hex)
+        self.chain = chain
+
+    def call(self, method, **kw):
+        if method == "status":
+            return {"latest_block_height": max(self.chain, default=0)}
+        if method == "blockchain":
+            lo, hi = kw["min_height"], kw["max_height"]
+            return {"block_metas": [
+                {"header": {"height": h, "app_hash": self.chain[h][1]},
+                 "block_id": {"hash": self.chain[h][0]}}
+                for h in range(hi, lo - 1, -1) if h in self.chain]}
+        raise AssertionError(method)
+
+
+def _monitor_for(chains):
+    mon = SocketInvariantMonitor.__new__(SocketInvariantMonitor)
+    mon.clients = [_FakeClient(c) for c in chains]
+    mon.poll_s = 0.01
+    mon.violations = []
+    mon.checks = {}
+    mon.heights = {}
+    mon.per_height = {}
+    mon.progress = []
+    mon._audited = {}
+    mon._stop = threading.Event()
+    mon._thread = None
+    return mon
+
+
+def test_monitor_accepts_identical_chains():
+    chain = {1: ("aa", "11"), 2: ("bb", "22")}
+    mon = _monitor_for([dict(chain), dict(chain)])
+    for i, c in enumerate(mon.clients):
+        mon._poll_node(i, c)
+    assert mon.violations == []
+    assert mon.checks["agreement"] == 2
+    assert mon.checks["apphash"] == 2
+
+
+def test_monitor_flags_agreement_and_apphash_divergence():
+    mon = _monitor_for([{1: ("aa", "11")}, {1: ("aa", "99")},
+                        {1: ("cc", "11")}])
+    for i, c in enumerate(mon.clients):
+        mon._poll_node(i, c)
+    kinds = sorted(v["invariant"] for v in mon.violations)
+    assert "apphash" in kinds and "agreement" in kinds
+
+
+def test_monitor_flags_height_regression():
+    mon = _monitor_for([{3: ("aa", "11")}])
+    mon._poll_node(0, mon.clients[0])
+    mon.clients[0].chain = {2: ("bb", "22")}
+    mon._audited[0] = 3  # already audited past it
+    mon._poll_node(0, mon.clients[0])
+    assert any(v["invariant"] == "validity" for v in mon.violations)
+
+
+def test_monitor_recovery_and_liveness_verdicts():
+    mon = _monitor_for([{1: ("aa", "11")}])
+    t = time.monotonic()
+    mon.progress = [(t + 1.0, 5), (t + 8.0, 6)]
+    report = mon.finalize(
+        [("partition", t), ("reset", t + 5.0), ("stall", t + 100.0)],
+        liveness_bound_s=4.0)
+    eps = {e["kind"]: e["recovery_s"] for e in
+           report["recovery"]["episodes"]}
+    assert eps["partition"] == 1.0
+    assert eps["reset"] == 3.0
+    assert eps["stall"] is None     # never recovered => liveness trip
+    assert [v["invariant"] for v in report["violations"]] == ["liveness"]
+    assert report["recovery"]["latency_seconds"]["n"] == 2
