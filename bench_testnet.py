@@ -368,7 +368,9 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                profile: str = "", reactor: str = "",
                wire_chaos: dict = None, wire_seed: int = 0,
                hostile: tuple = (), liveness_bound_s: float = 30.0,
-               child_env: dict = None, p2p_cfg: dict = None) -> dict:
+               child_env: dict = None, p2p_cfg: dict = None,
+               slo: str = "", slo_sample: float = 0.0,
+               tx_subscribers: int = 0) -> dict:
     """Config 1 over REAL sockets: n_vals separate OS processes
     (`cli node --p2p`), real TCP P2P + secret connections + local ABCI,
     txs injected over HTTP RPC by background spammer threads; commit
@@ -409,6 +411,11 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         #         loop = one event loop per node, threads = the
         #         per-connection thread plane; "" inherits caller env
         env["TM_TPU_REACTOR"] = reactor
+    if slo:  # tx-lifecycle SLO plane A/B for every node (bench.py
+        #     --slo-json); "" inherits whatever the caller exported
+        env["TM_TPU_SLO"] = slo
+        if slo_sample > 0:
+            env["TM_TPU_SLO_SAMPLE"] = str(slo_sample)
     if child_env:  # per-run node knobs (bench.py --wirechaos-json uses
         #           this to shorten ban windows so the unban shows up
         #           inside the measured window)
@@ -450,6 +457,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
     wire_t0 = None
     hostile_threads: list = []
     hostile_reports: list = []
+    slo_subs: list = []
     if wire_chaos is not None:
         from tendermint_tpu.chaos import wire as wire_mod
         proxy, wire_sched = wire_mod.proxy_for_testnet(
@@ -546,6 +554,38 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                     for t in range(n_spammers)]
         for t in spammers:
             t.start()
+
+        slo_on = bool(slo) and slo.lower() not in knobs.FALSY
+        if tx_subscribers > 0:
+            # Tx-event WS subscribers per node: the delivery-stage
+            # witness for an SLO run (each node's deliver stamp is a
+            # real fan-out socket write), attached INDEPENDENTLY of
+            # the SLO knob so an off-vs-on A/B carries identical
+            # event-delivery load on both arms; a bench-side thread
+            # empties the client queues so nothing backlogs
+            import queue as _queue
+            from tendermint_tpu.rpc.client import WSClient
+            for i in range(n_vals):
+                for _ in range(tx_subscribers):
+                    ws = WSClient("127.0.0.1", base + 2 * i + 1)
+                    ws.subscribe("tm.event = 'Tx'")
+                    slo_subs.append(ws)
+
+            def drain_events():
+                while not stop.is_set():
+                    drained = False
+                    for ws in slo_subs:
+                        try:
+                            for _ in range(4096):
+                                ws.events.get_nowait()
+                                drained = True
+                        except _queue.Empty:
+                            pass
+                    if not drained:
+                        time.sleep(0.05)
+
+            threading.Thread(target=drain_events, daemon=True,
+                             name="bench-slo-drain").start()
         # pre-fill: HTTP injection (~500 tx/s on this shared core) is
         # slower than commit throughput, so build a mempool BACKLOG
         # first — the measured window then reaps config-1-shaped
@@ -681,6 +721,16 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
                 except (OSError, RPCClientError) as e:
                     print(f"[bench] profile fetch failed: {e!r}",
                           file=sys.stderr)
+        slo_reports = []
+        if slo_on:
+            # every node's SLO snapshot WITH mergeable sketches before
+            # teardown (bench.py / scripts/slo_report.py merge them)
+            for c in clients:
+                try:
+                    slo_reports.append(c.call("slo", sketches=True))
+                except (OSError, RPCClientError) as e:
+                    print(f"[bench] slo fetch failed: {e!r}",
+                          file=sys.stderr)
         parity_report = {}
         if parity:
             # bit-identity audit BEFORE teardown: serial replay of the
@@ -723,6 +773,7 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             **({"wire": wire_report} if wire_report else {}),
             **({"timelines": timelines} if timelines else {}),
             **({"profiles": profiles} if profiles else {}),
+            **({"slo_reports": slo_reports} if slo_reports else {}),
         }
     except BaseException:
         # keep the net tree and surface log tails: the node logs are
@@ -739,6 +790,11 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
         raise
     finally:
         stop.set()
+        for ws in slo_subs:
+            try:
+                ws.close()
+            except OSError:
+                pass
         if wire_monitor is not None:
             wire_monitor.stop()
         if proxy is not None:

@@ -101,6 +101,17 @@ EXTRACTORS = (
      "faulted_over_clean_blocks_ratio", "x", "up"),
     ("wirechaos_recovery_p50_s", "BENCH_wirechaos.json",
      "recovery.latency_seconds.p50", "s", "down"),
+    # the ISSUE-14 tx-lifecycle SLO plane: user-visible latency from
+    # broadcast_tx admission to block commit and to WS event delivery
+    # (deterministically sampled txs through the async front door) —
+    # the regression gate finally covers what a CLIENT experiences,
+    # not just node-internal phase costs
+    ("slo_commit_p50_ms", "BENCH_slo.json",
+     "stages.e2e_commit.p50_ms", "ms", "down"),
+    ("slo_commit_p99_ms", "BENCH_slo.json",
+     "stages.e2e_commit.p99_ms", "ms", "down"),
+    ("slo_delivery_p99_ms", "BENCH_slo.json",
+     "stages.e2e_delivery.p99_ms", "ms", "down"),
     ("mesh_8dev_verifies_per_sec", "BENCH_mesh.json",
      "points[devices=8].verifies_per_sec", "verifies/sec", "up"),
     ("statesync_speedup_vs_replay", "BENCH_sync.json",

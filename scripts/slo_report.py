@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""slo_report — N per-node /slo payloads -> one cluster latency table.
+
+Fetches every node's tx-lifecycle SLO snapshot (the `slo` RPC route
+with sketches=true, or snapshot files on disk), concatenates the
+weighted quantile-sketch samples — sampling is deterministic and
+hash-based, so every node tracked the SAME txs and the merge is a
+straight weighted union — and prints one per-stage p50/p95/p99/p999
+table for the cluster, plus per-node completion/drop accounting.
+
+Usage:
+    python scripts/slo_report.py \
+        http://127.0.0.1:46657 http://127.0.0.1:46659 ...
+    python scripts/slo_report.py --files slo0.json slo1.json ...
+        [--report report.json]
+
+Nodes must run with TM_TPU_SLO=on; a node with the plane off is
+reported and skipped. The merge itself lives in
+tendermint_tpu/telemetry/slo.py (importable, unit-tested)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tendermint_tpu.telemetry import slo  # noqa: E402
+
+
+def fetch(url: str) -> dict:
+    """One node's SLO snapshot (with mergeable sketches) over its
+    JSON-RPC endpoint."""
+    from tendermint_tpu.rpc.client import JSONRPCClient
+    return JSONRPCClient(url).call("slo", sketches=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("sources", nargs="*",
+                    help="node RPC base URLs (http://host:port)")
+    ap.add_argument("--files", nargs="*", default=[],
+                    help="read snapshot files instead of fetching "
+                         "over RPC")
+    ap.add_argument("--report", default="",
+                    help="also write the merged table + per-node "
+                         "accounting as JSON")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for path in args.files:
+        with open(path) as f:
+            docs.append(json.load(f))
+    for url in args.sources:
+        docs.append(fetch(url))
+    if not docs:
+        ap.error("no sources: pass node URLs or --files")
+
+    live = []
+    for d in docs:
+        if not d.get("enabled"):
+            print(f"[slo_report] node {d.get('node', '?')}: SLO plane "
+                  f"off (TM_TPU_SLO?), skipped", file=sys.stderr)
+            continue
+        if not d.get("sketches"):
+            print(f"[slo_report] node {d.get('node', '?')}: no "
+                  f"sketches in payload (call with sketches=true), "
+                  f"skipped", file=sys.stderr)
+            continue
+        live.append(d)
+    if not live:
+        print("[slo_report] no SLO-enabled nodes", file=sys.stderr)
+        return 1
+
+    merged = slo.merge_snapshots(live)
+    print(f"[slo_report] {len(live)} nodes, "
+          f"{merged['sampled_total']} sampled, "
+          f"{merged['completed_total']} delivered, "
+          f"{merged['dropped']} dropped, "
+          f"{merged['in_flight']} in flight")
+    stages = merged["stages"]
+    if stages:
+        width = max(len(s) for s in stages)
+        print(f"  {'stage'.ljust(width)}  {'count':>7}  {'p50':>9}  "
+              f"{'p95':>9}  {'p99':>9}  {'p999':>9}  (ms)")
+        for name in slo.SERIES:
+            row = stages.get(name)
+            if row is None:
+                continue
+            print(f"  {name.ljust(width)}  {row['count']:>7}  "
+                  f"{row['p50_ms']:>9}  {row['p95_ms']:>9}  "
+                  f"{row['p99_ms']:>9}  {row['p999_ms']:>9}")
+    for d in live:
+        att = d.get("attribution", {})
+        if att.get("ready"):
+            print(f"  node {d.get('node', '?')}: p99 tail dominated by "
+                  f"'{att['dominant_stage']}' "
+                  f"(mean legs ms: {att['mean_leg_ms']})")
+
+    if args.report:
+        report = {
+            "merged": merged,
+            "per_node": [
+                {"node": d.get("node", "?"),
+                 "sampled_total": d.get("sampled_total", 0),
+                 "completed_total": d.get("completed_total", 0),
+                 "dropped": d.get("dropped", {}),
+                 "verdict": d.get("verdict", {}),
+                 "attribution": d.get("attribution", {})}
+                for d in live],
+        }
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"[slo_report] full report -> {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

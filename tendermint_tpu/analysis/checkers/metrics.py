@@ -31,6 +31,7 @@ KNOWN_SUBSYSTEMS = {
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
     "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
+    "slo",
 }
 
 INSTRUMENTED_MODULES = [
@@ -57,6 +58,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.p2p.conn.loop",      # tm_loop_* reactor-loop core
     "tendermint_tpu.rpc.aserver",        # tm_rpc_* async front door
     "tendermint_tpu.chaos.wire",         # tm_wire_* TCP fault proxy
+    "tendermint_tpu.telemetry.slo",      # tm_slo_* tx-lifecycle plane
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric
@@ -110,6 +112,8 @@ def run() -> List[Finding]:
         series = {name}
         if fam.kind == "histogram":
             series = {name + s for s in ("_bucket", "_sum", "_count")}
+        elif fam.kind == "summary":
+            series = {name, name + "_sum", name + "_count"}
         clash = series & exposed
         if clash:
             problem(f"{name}: exposition series collide: {clash}")

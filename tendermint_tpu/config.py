@@ -90,6 +90,14 @@ class BaseConfig:
     prof: str = "off"
     prof_hz: float = 0.0  # 0 = profile.DEFAULT_HZ (13)
     queue_watch: str = "on"
+    # tx-lifecycle SLO plane (telemetry/slo.py): `slo` on stamps
+    # sampled txs at each stage boundary (front-door admit -> CheckTx
+    # -> proposal -> commit -> publish -> WS delivery) into per-stage
+    # quantile sketches served at /slo and folded into /healthz;
+    # `slo_sample` is the deterministic hash-based sampling rate.
+    # TM_TPU_SLO / TM_TPU_SLO_SAMPLE win over these.
+    slo: str = "off"
+    slo_sample: float = 1.0
     # async reactor core (p2p/conn/loop.py): "loop" (= auto, the
     # default) runs every peer socket, gossip routine and RPC/WebSocket
     # connection on ONE selector event loop per node; "threads"

@@ -25,6 +25,7 @@ from typing import Callable, List, Optional
 
 from tendermint_tpu import pipeline, telemetry
 from tendermint_tpu.telemetry import causal
+from tendermint_tpu.telemetry import slo as slo_plane
 from tendermint_tpu.config import ConsensusConfig
 from tendermint_tpu.consensus.rstate import HeightVoteSet, RoundState, Step
 from tendermint_tpu.consensus.ticker import MockTicker, TimeoutInfo, TimeoutTicker
@@ -116,6 +117,10 @@ class ConsensusState:
         # resolved once like the pipeline knob; off = zero per-height
         # span recording and untouched broadcast envelopes
         self._trace = causal.enabled()
+        # tx-lifecycle SLO plane (telemetry/slo.py, TM_TPU_SLO):
+        # resolved once the same way; off = the per-block stamp calls
+        # below never run (not even the hash of a single tx)
+        self._slo = slo_plane.enabled()
         self._pre_lock = threading.Lock()
         # next-proposal precompute handoff (worker -> propose step)
         self._precomputed = None  #: guarded_by _pre_lock
@@ -492,6 +497,10 @@ class ConsensusState:
             if not self.replay_mode:
                 self._log(f"error signing proposal: {e!r}")
             return
+        if self._slo and not self.replay_mode:
+            # SLO proposal-inclusion stamp (proposer side; receivers
+            # stamp when their part set completes — first wins)
+            slo_plane.mark_many(block.data.txs, "propose", height)
         # own proposal + parts ride the same queue as peer messages
         proposal_msg = {"type": "proposal", "proposal": proposal.to_obj()}
         self._enqueue_own(proposal_msg)
@@ -1018,6 +1027,8 @@ class ConsensusState:
             data = rs.proposal_block_parts.get_data()
             block = Block.from_bytes(data)
             rs.proposal_block = block
+            if self._slo and not self.replay_mode:
+                slo_plane.mark_many(block.data.txs, "propose", height)
             if rs.step == Step.PROPOSE and self._is_proposal_complete():
                 self._enter_prevote(height, rs.round)
             elif rs.step == Step.COMMIT:

@@ -32,6 +32,7 @@ from tendermint_tpu import telemetry
 from tendermint_tpu.abci.types import ResultCheckTx
 from tendermint_tpu.mempool.clist import CList
 from tendermint_tpu.telemetry import queues as queue_obs
+from tendermint_tpu.telemetry import slo as slo_obs
 
 _m_size = telemetry.gauge(
     "mempool_size", "Pending transactions in the mempool")
@@ -220,6 +221,10 @@ class Mempool:
                 _m_rejected.labels("invalid").inc()
         if notify:
             self.txs_available_hook()
+        if res.ok:
+            # SLO plane: CheckTx-accept stamp for sampled txs (outside
+            # proxy_mtx — the tracker has its own lock)
+            slo_obs.mark(tx, "checktx")
         return res
 
     def check_tx_batch(self, txs: List[bytes]) -> List[ResultCheckTx]:
@@ -274,6 +279,7 @@ class Mempool:
                 notify = self._mark_txs_available()
         if notify:
             self.txs_available_hook()
+        slo_obs.mark_many(wal_buf, "checktx")
         return out
 
     def _mark_txs_available(self) -> bool:

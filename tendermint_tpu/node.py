@@ -98,6 +98,14 @@ class Node:
                            hz=getattr(config.base, "prof_hz", 0.0))
         _queues.configure(mode=getattr(config.base, "queue_watch", "on"))
 
+        # tx-lifecycle SLO plane (env TM_TPU_SLO/_SLO_SAMPLE win inside
+        # the resolvers; off = one cached flag check per entry point).
+        # Process-global like the profiler: in-process testnets share
+        # one tracker and stage stamps are first-wins idempotent.
+        from tendermint_tpu.telemetry import slo as _slo
+        _slo.configure(mode=getattr(config.base, "slo", "off"),
+                       sample=getattr(config.base, "slo_sample", None))
+
         def db_path(name):
             if in_memory:
                 return None

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Optional
 from urllib.parse import parse_qsl, urlparse
 
 from tendermint_tpu import telemetry
+from tendermint_tpu.telemetry import slo as _slo
 from tendermint_tpu.rpc.server import (
     MAX_BODY_BYTES,
     RPCError,
@@ -70,9 +71,13 @@ _m_subscribers = telemetry.gauge(
     "rpc_ws_subscribers", "Live WebSocket event subscriptions")
 _m_events_sent = telemetry.counter(
     "rpc_events_sent_total", "Events pushed to WebSocket subscribers")
+# labelled by route so the SLO plane's tail attribution can separate
+# broadcast_tx_* admission cost from query traffic; unregistered
+# method names collapse into one "unknown" label (clients control the
+# method string — it must not mint unbounded label values)
 _m_call_seconds = telemetry.histogram(
-    "rpc_call_seconds", "Handler wall time per JSON-RPC call",
-    buckets=(1e-4, 1e-3, 5e-3, 2.5e-2, 1e-1, 1.0, 10.0))
+    "rpc_call_seconds", "Handler wall time per JSON-RPC call, by route",
+    ("route",), buckets=(1e-4, 1e-3, 5e-3, 2.5e-2, 1e-1, 1.0, 10.0))
 
 DEFAULT_MAX_CONNS = 4096
 WORKERS = 6
@@ -278,6 +283,8 @@ class AsyncRPCServer:
         _m_requests.labels(transport).inc()
         self._inflight += 1
         tele = telemetry.enabled()
+        route = method if isinstance(method, str) and \
+            method in self.funcs else "unknown"
 
         def work():
             t0 = time.perf_counter() if tele else 0.0
@@ -287,7 +294,8 @@ class AsyncRPCServer:
             except RPCError as e:
                 resp = _rpc_response(id_, error=e)
             if tele:
-                _m_call_seconds.observe(time.perf_counter() - t0)
+                _m_call_seconds.labels(route).observe(
+                    time.perf_counter() - t0)
             self.loop.call_soon(lambda: self._complete(send, resp),
                                 owner="rpc")
 
@@ -357,6 +365,7 @@ class _AsyncWS:
                 conn.send_ws_text(
                     conn.server.render_event(item, render))
                 _m_events_sent.inc()
+                _slo.deliver_item(item)
             # outbuf high: resume when the socket drains
             conn.on_drain = schedule
 

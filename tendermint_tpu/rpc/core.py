@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, Optional
 
 from tendermint_tpu.rpc.server import RPCError
+from tendermint_tpu.telemetry import slo as slo_obs
 from tendermint_tpu.types.events import EventTx, Query, TagTxHash
 
 
@@ -202,6 +203,7 @@ class RPCCore:
             "dump_height_timeline": self.dump_height_timeline,
             "debug_profile": self.debug_profile,
             "healthz": self.healthz,
+            "slo": self.slo,
         }
         if self.env.unsafe:
             r.update({
@@ -431,6 +433,7 @@ class RPCCore:
         batcher (async server) the tx rides the next merged
         check_tx_batch; the threaded path keeps its one-off thread."""
         import hashlib
+        slo_obs.admit(tx)
         if self.tx_batcher is not None:
             self.tx_batcher.submit(tx, wait=False)
         else:
@@ -447,6 +450,7 @@ class RPCCore:
     def broadcast_tx_sync(self, tx: bytes) -> dict:
         """Wait for CheckTx result (rpc/core/mempool.go:91)."""
         import hashlib
+        slo_obs.admit(tx)
         res = self._check_tx(tx)
         return jsonify({"code": res.code, "data": res.data,
                         "log": res.log,
@@ -466,6 +470,7 @@ class RPCCore:
                    for t in txs]
         except (ValueError, AttributeError) as e:
             raise RPCError(-32602, f"bad tx hex: {e}") from e
+        slo_obs.admit_many(raw)
         mp = self.env.mempool
         if hasattr(mp, "check_tx_batch"):
             results = mp.check_tx_batch(raw)
@@ -490,6 +495,7 @@ class RPCCore:
         (rpc/core/mempool.go:109): subscribe to EventTx for this hash
         BEFORE submitting, then block on delivery."""
         import hashlib
+        slo_obs.admit(tx)
         bus = self.env.event_bus
         tx_hash = hashlib.sha256(tx).hexdigest().upper()
         subscriber = f"bcast-{tx_hash[:16]}-{time.monotonic_ns()}"
@@ -593,6 +599,16 @@ class RPCCore:
         doc["node"] = causal.node()
         return doc
 
+    def slo(self, sketches: bool = False) -> dict:
+        """The tx-lifecycle SLO table (telemetry/slo.py): per-stage
+        p50/p95/p99/p999 over the cumulative sketches and the
+        1s/10s/60s rolling windows, in-flight and drop/timeout
+        accounting, tail attribution, and the health verdict.
+        `sketches=true` adds the mergeable weighted samples
+        scripts/slo_report.py concatenates across nodes. Also served
+        raw at GET /slo."""
+        return jsonify(slo_obs.snapshot(sketches=bool(sketches)))
+
     def healthz(self) -> dict:
         """One JSON verdict for load balancers and operators: height
         progress, queue saturation (telemetry/queues.py catalog), the
@@ -608,8 +624,15 @@ class RPCCore:
         prof = profile.get()
         syncing = (self.env.blockchain_reactor is not None and
                    not self.env.blockchain_reactor.synced)
+        # SLO verdict fold-in: sampled txs failing to complete (drops
+        # beyond 5% of the 60s window's completions, or a saturated
+        # tracker) flip the health bit — always {"ok": True} while
+        # the plane is off
+        slo_verdict = slo_obs.verdict()
         doc = {
-            "ok": not saturated and not stalled,
+            "ok": (not saturated and not stalled and
+                   slo_verdict["ok"]),
+            "slo": {"enabled": slo_obs.enabled(), **slo_verdict},
             "height": cs.state.last_block_height
             if cs is not None else 0,
             "syncing": syncing,
@@ -765,6 +788,7 @@ class RPCCore:
                                   "result": {"query": item.query,
                                              "data": jsonify(item.data),
                                              "tags": jsonify(item.tags)}})
+                    slo_obs.deliver_item(item)
                 except ConnectionError:
                     return
 
@@ -817,6 +841,9 @@ def make_server(env: RPCEnv, loop=None):
         return "" if p is None else p.collapsed()
 
     server.raw_routes["/healthz"] = ("application/json", core.healthz)
+    # raw GET /slo: the tx-lifecycle SLO table (per-stage quantiles,
+    # windows, tail attribution) — same payload as the `slo` route
+    server.raw_routes["/slo"] = ("application/json", core.slo)
     server.raw_routes["/debug/pprof"] = (
         "text/plain; charset=utf-8", _pprof_text)
     return server, core

@@ -278,6 +278,13 @@ def fire_events(event_bus, block: Block, block_id: BlockID,
                 responses: ABCIResponses) -> None:
     """state/execution.go:343: NewBlock + NewBlockHeader + one EventTx per
     tx with its DeliverTx result."""
+    from tendermint_tpu.telemetry import slo
+    # SLO commit stamp at the moment the COMMITTED block's events fan
+    # out: after the group flush in pipelined mode, after store writes
+    # in serial — and strictly before the publish/deliver stamps the
+    # per-tx events below produce, so every sampled tx's stage stamps
+    # stay monotonic (mark_many short-circuits when nothing is tracked)
+    slo.mark_many(block.data.txs, "commit", block.header.height)
     event_bus.publish_new_block(block, block_id)
     event_bus.publish_new_block_header(block.header)
     for i, tx in enumerate(block.data.txs):

@@ -29,13 +29,16 @@ one flag check.
 
 from tendermint_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS,
+    DEFAULT_QUANTILES,
     POW2_BUCKETS,
     RATIO_BUCKETS,
     Counter,
     Gauge,
     Histogram,
+    QuantileSketch,
     REGISTRY,
     Registry,
+    Summary,
     configure,
     enabled,
     namespace,
@@ -60,6 +63,12 @@ def gauge(name, help="", labelnames=()):
 
 def histogram(name, help="", labelnames=(), buckets=DEFAULT_BUCKETS):
     return REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+def summary(name, help="", labelnames=(), quantiles=DEFAULT_QUANTILES,
+            cap=512):
+    return REGISTRY.summary(name, help, labelnames,
+                            quantiles=quantiles, cap=cap)
 
 
 def expose(namespace=None) -> str:

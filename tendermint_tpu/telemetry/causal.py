@@ -68,12 +68,14 @@ from tendermint_tpu.utils import knobs
 #   snapshot.restore state-sync restore apply (assemble/verify/bootstrap)
 #   sync.chunk       one verified snapshot chunk landed (origin + bytes)
 #   queue.saturated  queue-observatory watchdog episode (kind + depth)
+#   slo.sample       a sampled tx completed delivery (hash + e2e ms) —
+#                    the SLO plane's join key into the span timeline
 SPAN_CATALOG = frozenset((
     "height.begin", "propose", "proposal.recv", "part.first",
     "block.full", "quorum.prevote", "quorum.precommit",
     "verify.dispatch", "apply", "flush", "wal.fsync", "commit",
     "p2p.recv", "mempool.recv", "stall",
-    "snapshot.restore", "sync.chunk", "queue.saturated",
+    "snapshot.restore", "sync.chunk", "queue.saturated", "slo.sample",
 ))
 
 DEFAULT_CAPACITY = 65536

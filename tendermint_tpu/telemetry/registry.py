@@ -43,6 +43,10 @@ POW2_BUCKETS: Tuple[float, ...] = tuple(
 RATIO_BUCKETS: Tuple[float, ...] = (
     0.125, 0.25, 0.5, 0.75, 0.9, 1.0)
 
+# Default quantiles a Summary family exposes (the SLO plane's table:
+# median, tail, deep tail).
+DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99, 0.999)
+
 
 def _env_enabled() -> Optional[bool]:
     """TM_TPU_TELEMETRY: unset -> None (config decides, default on);
@@ -184,6 +188,142 @@ class _HistogramChild:
             return list(self.counts), self.sum, self.count
 
 
+class QuantileSketch:
+    """Fixed-capacity quantile estimator (the SLO plane's per-stage
+    latency structure — ISSUE 14).
+
+    Histogram's DEFAULT_BUCKETS are far too coarse for sub-millisecond
+    front-door legs (everything lands in the first bucket), and keeping
+    every sample exact grows without bound over a soak. This is the
+    classic multi-level compactor sketch: observations enter a level-0
+    buffer; when a level fills, it is sorted and every OTHER element is
+    promoted one level up with doubled weight (the surviving parity
+    alternates per compaction, so rank bias cancels instead of
+    accumulating). Memory is O(cap * log(n / cap)); quantiles are EXACT
+    until the first compaction (n <= cap) and carry a bounded rank
+    error (~levels / cap) after — test-asserted against sorted ground
+    truth in tests/test_slo.py.
+
+    Deterministic by construction (no RNG: the alternating-parity
+    compactor replaces KLL's coin flip), so two nodes fed the same
+    stream expose identical quantiles. Thread-safe."""
+
+    __slots__ = ("_lock", "_cap", "_levels", "_parity", "count", "sum",
+                 "_min", "_max")
+
+    def __init__(self, cap: int = 512):
+        if cap < 8:
+            raise ValueError(f"sketch cap must be >= 8, got {cap}")
+        self._lock = threading.Lock()
+        self._cap = int(cap)
+        self._levels: list = [[]]   # level i holds items of weight 2^i
+        self._parity: list = [0]
+        self.count = 0
+        self.sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            self._levels[0].append(v)
+            i = 0
+            while len(self._levels[i]) >= self._cap:
+                buf = sorted(self._levels[i])
+                keep = self._parity[i]
+                self._parity[i] ^= 1
+                self._levels[i] = []
+                if i + 1 == len(self._levels):
+                    self._levels.append([])
+                    self._parity.append(0)
+                self._levels[i + 1].extend(buf[keep::2])
+                i += 1
+
+    def items(self):
+        """Weighted samples [(value, weight), ...] — the mergeable form
+        scripts/slo_report.py concatenates across nodes."""
+        with self._lock:
+            out = []
+            for i, buf in enumerate(self._levels):
+                w = 1 << i
+                out.extend((v, w) for v in buf)
+            return out
+
+    def quantile(self, q: float) -> float:
+        """Value at rank q*(n-1) over the weighted sample set; exact
+        min/max at q=0/1 regardless of compaction. NaN when empty."""
+        return quantile_of_items(self.items(), q,
+                                 lo=self._min, hi=self._max)
+
+    def quantiles(self, qs) -> dict:
+        items = self.items()
+        return {q: quantile_of_items(items, q, lo=self._min,
+                                     hi=self._max) for q in qs}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._levels = [[]]
+            self._parity = [0]
+            self.count = 0
+            self.sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
+
+
+def quantile_of_items(items, q: float, lo: float = math.inf,
+                      hi: float = -math.inf) -> float:
+    """Quantile over weighted (value, weight) pairs — shared by
+    QuantileSketch and the cross-node merge in scripts/slo_report.py."""
+    if not items:
+        return math.nan
+    q = min(1.0, max(0.0, float(q)))
+    if q == 0.0 and lo is not math.inf and not math.isinf(lo):
+        return lo
+    if q == 1.0 and hi is not -math.inf and not math.isinf(hi):
+        return hi
+    items = sorted(items)
+    total = sum(w for _, w in items)
+    target = q * (total - 1)
+    cum = 0
+    for v, w in items:
+        cum += w
+        if cum - 1 >= target:
+            return v
+    return items[-1][0]
+
+
+class _SummaryChild:
+    """One labelled summary: a QuantileSketch exposed as the Prometheus
+    summary type (`x{quantile="0.99"} v` + `x_sum` + `x_count`)."""
+
+    __slots__ = ("sketch",)
+
+    def __init__(self, cap: int):
+        self.sketch = QuantileSketch(cap)
+
+    def observe(self, value: float) -> None:
+        if not _state.enabled:
+            return
+        self.sketch.observe(value)
+
+    def reset(self) -> None:
+        self.sketch.reset()
+
+    @property
+    def count(self) -> int:
+        return self.sketch.count
+
+    @property
+    def sum(self) -> float:
+        return self.sketch.sum
+
+
 # --------------------------------------------------------------------------
 # families
 # --------------------------------------------------------------------------
@@ -304,6 +444,36 @@ class Histogram(_Family):
         self._implicit.observe(value)
 
 
+class Summary(_Family):
+    """Quantile-sketch family (Prometheus summary type): per-child
+    QuantileSketch, exposed as `x{quantile="0.5"} v` lines plus _sum and
+    _count. Built for the SLO plane's sub-ms latency legs, where
+    DEFAULT_BUCKETS resolve nothing."""
+
+    kind = "summary"
+
+    def __init__(self, name, help, labelnames,
+                 quantiles: Sequence[float] = DEFAULT_QUANTILES,
+                 cap: int = 512):
+        qs = tuple(float(q) for q in quantiles)
+        if any(not 0.0 <= q <= 1.0 for q in qs) or \
+                list(qs) != sorted(set(qs)):
+            raise ValueError(f"summary {name!r} quantiles must be "
+                             f"sorted, unique, in [0,1]: {quantiles}")
+        self.quantiles = qs
+        self.cap = int(cap)
+        super().__init__(name, help, labelnames)
+
+    def _new_child(self):
+        return _SummaryChild(self.cap)
+
+    def observe(self, value: float) -> None:
+        if self._implicit is None:
+            raise ValueError(f"summary {self.name!r} has labels; "
+                             f"call .labels() first")
+        self._implicit.observe(value)
+
+
 # --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
@@ -360,6 +530,13 @@ class Registry:
         return self._register(Histogram, name, help, labelnames,
                               buckets=buckets)
 
+    def summary(self, name: str, help: str = "",
+                labelnames: Sequence[str] = (),
+                quantiles: Sequence[float] = DEFAULT_QUANTILES,
+                cap: int = 512) -> Summary:
+        return self._register(Summary, name, help, labelnames,
+                              quantiles=quantiles, cap=cap)
+
     def _register(self, cls, name, help, labelnames, **kw) -> _Family:
         if not _NAME_RE.match(name or ""):
             raise ValueError(f"bad metric name {name!r} "
@@ -378,6 +555,11 @@ class Registry:
                     if want and math.isinf(want[-1]):
                         want = want[:-1]
                     same = fam.buckets == want
+                if same and cls is Summary:
+                    want_q = tuple(float(q) for q in kw.get(
+                        "quantiles", DEFAULT_QUANTILES))
+                    same = fam.quantiles == want_q and \
+                        fam.cap == int(kw.get("cap", 512))
                 if not same:
                     raise ValueError(
                         f"metric {name!r} already registered as "
@@ -419,6 +601,9 @@ class Registry:
                 cum += c
                 out[upper] = cum
             return {"sum": s, "count": n, "buckets": out}
+        if isinstance(fam, Summary):
+            return {"sum": child.sum, "count": child.count,
+                    "quantiles": child.sketch.quantiles(fam.quantiles)}
         return child.value
 
     def reset(self) -> None:
@@ -432,6 +617,8 @@ class Registry:
                         child.counts = [0] * len(child.counts)
                         child.sum = 0.0
                         child.count = 0
+                elif isinstance(child, _SummaryChild):
+                    child.reset()
                 else:
                     with child._lock:
                         child.value = 0.0
@@ -456,7 +643,18 @@ class Registry:
             lines.append(f"# HELP {full} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {full} {fam.kind}")
             for values, child in sorted(fam.children()):
-                if isinstance(fam, Histogram):
+                if isinstance(fam, Summary):
+                    qvals = child.sketch.quantiles(fam.quantiles)
+                    for q, v in qvals.items():
+                        if math.isnan(v):
+                            continue  # empty sketch: only _sum/_count
+                        ls = _labelstr(fam.labelnames, values,
+                                       extra=(("quantile", _fmt(q)),))
+                        lines.append(f"{full}{ls} {_fmt(v)}")
+                    ls = _labelstr(fam.labelnames, values)
+                    lines.append(f"{full}_sum{ls} {_fmt(child.sum)}")
+                    lines.append(f"{full}_count{ls} {child.count}")
+                elif isinstance(fam, Histogram):
                     counts, s, n = child.snapshot()
                     cum = 0
                     for upper, c in zip(fam.buckets, counts):

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from tendermint_tpu import telemetry
 from tendermint_tpu.telemetry import queues as queue_obs
+from tendermint_tpu.telemetry import slo as slo_obs
 
 _m_dropped = telemetry.counter(
     "event_dropped_total",
@@ -267,5 +268,9 @@ class EventBus:
         tags = dict(extra_tags or {})
         tags[TagTxHash] = hashlib.sha256(tx).hexdigest().upper()
         tags[TagTxHeight] = height
+        # SLO publish stamp BEFORE the fan-out: the deliver stamp (a
+        # subscriber socket write, possibly on the loop thread an
+        # instant later) must never precede it
+        slo_obs.mark_hex(tags[TagTxHash], "publish", height)
         self.publish(EventTx, {
             "height": height, "index": index, "tx": tx, "result": result}, tags)

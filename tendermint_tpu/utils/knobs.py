@@ -166,6 +166,18 @@ CATALOG: tuple[Knob, ...] = (
          "Profiler sampling rate, sweeps per second (default keeps a "
          "40-thread node under ~1% of a core).",
          "telemetry/profile.py"),
+    Knob("TM_TPU_SLO", "str", "off", "base.slo",
+         "Tx-lifecycle SLO plane: on stamps sampled transactions at "
+         "each stage boundary (front-door admit -> CheckTx -> proposal "
+         "-> commit -> event publish -> WS delivery) into per-stage "
+         "quantile sketches (/slo route, tm_slo_*); off = one cached "
+         "flag check per entry point, nothing hashed, wire untouched.",
+         "telemetry/slo.py"),
+    Knob("TM_TPU_SLO_SAMPLE", "float", "1.0", "base.slo_sample",
+         "SLO sampling probability: a tx is tracked iff the first 8 "
+         "bytes of its sha256 fall under rate*2^64 — deterministic, so "
+         "every node samples the SAME txs and cross-node reports join.",
+         "telemetry/slo.py"),
     Knob("TM_TPU_QUEUE_WATCH", "spec", "on (0.25s poll)",
          "base.queue_watch",
          "Queue observatory: off | on | <poll seconds>. Registers "

@@ -1,0 +1,524 @@
+"""Tx-lifecycle SLO plane (ISSUE 14): quantile-sketch accuracy vs
+sorted ground truth, deterministic hash sampling, TM_TPU_SLO=off
+zero-state neutrality, stage ordering + leg accounting, overflow and
+timeout eviction, rolling windows, the /healthz verdict fold-in, tail
+attribution, cross-node snapshot merging (the scripts/slo_report.py
+path), /slo + /healthz over HTTP in loop mode, the rpc_call_seconds
+route label, and end-to-end stage ordering on a 2-node socket net."""
+
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from tendermint_tpu import telemetry
+from tendermint_tpu.telemetry import slo
+from tendermint_tpu.telemetry.registry import (QuantileSketch,
+                                               quantile_of_items)
+
+
+@pytest.fixture(autouse=True)
+def _slo_reset(monkeypatch):
+    """The tracker is process-global; every test starts off/empty."""
+    monkeypatch.delenv("TM_TPU_SLO", raising=False)
+    monkeypatch.delenv("TM_TPU_SLO_SAMPLE", raising=False)
+    slo.configure("off")
+    slo.reset()
+    yield
+    slo.configure("off")
+    slo.reset()
+
+
+def _enable(monkeypatch, sample: str = "1.0"):
+    monkeypatch.setenv("TM_TPU_SLO", "on")
+    monkeypatch.setenv("TM_TPU_SLO_SAMPLE", sample)
+    slo.reset()
+
+
+# ------------------------------------------------------------- sketch
+
+def test_sketch_exact_under_cap():
+    s = QuantileSketch(64)
+    vals = [7.0, 1.0, 5.0, 3.0, 9.0]
+    for v in vals:
+        s.observe(v)
+    assert s.count == 5 and s.sum == sum(vals)
+    assert s.quantile(0.0) == 1.0
+    assert s.quantile(1.0) == 9.0
+    assert s.quantile(0.5) == 5.0
+    # empty sketch: NaN, not an exception
+    assert math.isnan(QuantileSketch(64).quantile(0.5))
+
+
+def test_sketch_accuracy_bounds_vs_sorted_ground_truth():
+    """After many compactions, every reported quantile's TRUE rank in
+    the sorted ground truth stays within 3% of the requested one."""
+    n, cap = 20000, 256
+    s = QuantileSketch(cap)
+    truth = []
+    for i in range(n):
+        v = float((i * 7919) % n)   # a permutation of 0..n-1
+        truth.append(v)
+        s.observe(v)
+    truth.sort()
+    assert s.count == n
+    assert s.sum == sum(truth)
+    for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+        est = s.quantile(q)
+        true_rank = truth.index(est) / (n - 1)
+        assert abs(true_rank - q) < 0.03, (q, est, true_rank)
+    # weight conservation: the compacted items still represent n obs
+    assert sum(w for _, w in s.items()) == pytest.approx(n, rel=0.02)
+
+
+def test_sketch_deterministic_across_instances():
+    a, b = QuantileSketch(64), QuantileSketch(64)
+    for i in range(5000):
+        v = float((i * 31) % 997)
+        a.observe(v)
+        b.observe(v)
+    assert a.items() == b.items()
+    assert a.quantile(0.99) == b.quantile(0.99)
+
+
+def test_quantile_of_items_weighted():
+    # weight 3 at 1.0, weight 1 at 10.0 -> p50 sits on the heavy value
+    items = [(1.0, 3), (10.0, 1)]
+    assert quantile_of_items(items, 0.5) == 1.0
+    assert quantile_of_items(items, 1.0) == 10.0
+    assert math.isnan(quantile_of_items([], 0.5))
+
+
+def test_summary_family_exposes_quantiles():
+    reg = telemetry.Registry()
+    fam = reg.summary("slo_test_seconds", "t", ("stage",))
+    fam.labels("x").observe(0.5)
+    fam.labels("x").observe(1.5)
+    val = reg.value("slo_test_seconds", {"stage": "x"})
+    assert val["count"] == 2 and val["sum"] == 2.0
+    assert val["quantiles"][0.5] in (0.5, 1.5)
+    text = reg.expose()
+    assert 'slo_test_seconds{stage="x",quantile="0.5"}' in \
+        text.replace("tm_", "")
+    assert "slo_test_seconds_count" in text
+    # conflicting re-registration is loud, identical is idempotent
+    assert reg.summary("slo_test_seconds", "t", ("stage",)) is fam
+    with pytest.raises(ValueError):
+        reg.summary("slo_test_seconds", "t", ("stage",),
+                    quantiles=(0.5,))
+
+
+# ----------------------------------------------------------- sampling
+
+def test_sampling_deterministic_and_rate_shaped(monkeypatch):
+    _enable(monkeypatch, "0.5")
+    import hashlib
+    txs = [b"tx-%d" % i for i in range(4000)]
+    decisions = [slo.sampled(hashlib.sha256(tx).digest()) for tx in txs]
+    # same hash -> same decision, every time (what makes the
+    # cross-node report a join instead of a guess)
+    again = [slo.sampled(hashlib.sha256(tx).digest()) for tx in txs]
+    assert decisions == again
+    frac = sum(decisions) / len(decisions)
+    assert 0.45 < frac < 0.55, frac
+    monkeypatch.setenv("TM_TPU_SLO_SAMPLE", "1.0")
+    slo.reset()
+    assert all(slo.sampled(hashlib.sha256(tx).digest()) for tx in txs)
+    monkeypatch.setenv("TM_TPU_SLO_SAMPLE", "0")
+    slo.reset()
+    assert not any(slo.sampled(hashlib.sha256(tx).digest())
+                   for tx in txs)
+
+
+def test_off_means_zero_state_and_identical_mempool_results():
+    """Default-off: no entry point records anything, and the mempool's
+    CheckTx surface returns field-identical results whether the plane
+    exists or not (it never touches the wire by construction)."""
+    assert slo.enabled() is False
+    before = telemetry.value("slo_sampled_total")
+    slo.admit(b"tx")
+    slo.mark(b"tx", "checktx")
+    slo.mark_many([b"tx"], "commit", 3)
+    assert len(slo.TRACKER._inflight) == 0
+    assert slo.TRACKER.sampled_total == 0
+    assert telemetry.value("slo_sampled_total") == before
+    snap = slo.snapshot()
+    assert snap["enabled"] is False
+    assert slo.verdict()["ok"] is True
+
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import local_client_creator
+    from tendermint_tpu.mempool import Mempool
+    mp = Mempool(local_client_creator(KVStoreApp())(), height=0)
+    res = mp.check_tx(b"k=v")
+    assert res.ok and slo.TRACKER.sampled_total == 0
+
+
+# ------------------------------------------------------ stage stamping
+
+def _mk_tracker(now):
+    return slo.SLOTracker(now_ns=lambda: now[0])
+
+
+def test_lifecycle_legs_and_monotonic_accounting(monkeypatch):
+    _enable(monkeypatch)
+    now = [1_000_000_000]
+    t = _mk_tracker(now)
+    tx = b"journey"
+    t.admit(tx)
+    for stage, step_ms in (("checktx", 1), ("propose", 20),
+                           ("commit", 200), ("publish", 2),
+                           ("deliver", 5)):
+        now[0] += step_ms * 1_000_000
+        t.mark(tx, stage, height=7)
+    assert t.completed_total == 1 and not t._inflight
+    assert t.monotonic_violations == 0
+    snap = t.snapshot(windows=False)
+    st = snap["stages"]
+    assert st["checktx"]["p50_ms"] == 1.0
+    assert st["propose"]["p50_ms"] == 20.0
+    assert st["commit"]["p50_ms"] == 200.0
+    assert st["e2e_commit"]["p50_ms"] == 221.0
+    assert st["e2e_delivery"]["p50_ms"] == 228.0
+    (rec,) = t._completed
+    assert rec["h"] == 7 and rec["total_ms"] == 228.0
+    # stamps are first-wins idempotent: re-marking changes nothing
+    t.mark(tx, "commit", height=9)
+    assert t.completed_total == 1
+
+
+def test_missing_intermediate_stage_closes_from_nearest(monkeypatch):
+    """A leg whose natural predecessor never stamped (e.g. no local
+    propose observation) closes from the nearest EARLIER stamp."""
+    _enable(monkeypatch)
+    now = [0]
+    t = _mk_tracker(now)
+    t.admit(b"x")
+    now[0] += 10_000_000
+    t.mark(b"x", "checktx")
+    now[0] += 90_000_000
+    t.mark(b"x", "commit", height=2)    # no propose stamp
+    snap = t.snapshot(windows=False)
+    assert snap["stages"]["commit"]["p50_ms"] == 90.0  # from checktx
+    assert "propose" not in snap["stages"]
+    assert snap["stages"]["e2e_commit"]["p50_ms"] == 100.0
+
+
+def test_unknown_stage_is_loud(monkeypatch):
+    _enable(monkeypatch)
+    t = _mk_tracker([0])
+    t.admit(b"x")
+    with pytest.raises(ValueError, match="unknown SLO stage"):
+        t.mark_hex(slo.tx_key(b"x"), "telaported")
+
+
+def test_overflow_eviction_counts(monkeypatch):
+    _enable(monkeypatch)
+    now = [0]
+    t = slo.SLOTracker(now_ns=lambda: now[0], inflight_cap=4)
+    for i in range(6):
+        t.admit(b"tx-%d" % i)
+    assert len(t._inflight) == 4
+    assert t.dropped["overflow"] == 2
+    assert t.sampled_total == 6
+
+
+def test_timeout_sweep_splits_undelivered(monkeypatch):
+    """Expired txs that never committed count as `timeout` (a health
+    failure); committed-but-never-delivered ones as `undelivered`
+    (no subscriber was listening — accounting, not alarm)."""
+    _enable(monkeypatch)
+    now = [0]
+    t = slo.SLOTracker(now_ns=lambda: now[0], timeout_s=1.0)
+    t.admit(b"stuck")
+    t.admit(b"committed")
+    t.mark(b"committed", "commit", height=1)
+    now[0] += 2_000_000_000
+    t.sweep()
+    assert not t._inflight
+    assert t.dropped["timeout"] == 1
+    assert t.dropped["undelivered"] == 1
+    assert t.timeout_last_stage == {"admit": 1, "commit": 1}
+    # the verdict flags the real failure class only
+    v = t.verdict()
+    assert v["ok"] is False
+    assert "drops_exceed_5pct_of_completions" in v["reasons"]
+
+
+def test_windows_roll_off(monkeypatch):
+    _enable(monkeypatch)
+    now = [0]
+    t = _mk_tracker(now)
+    t.admit(b"old")
+    now[0] += 1_000_000
+    t.mark(b"old", "checktx")
+    # 30s later: a second tx
+    now[0] += 30_000_000_000
+    t.admit(b"new")
+    now[0] += 2_000_000
+    t.mark(b"new", "checktx")
+    snap = t.snapshot()
+    w = snap["windows"]
+    assert w["1s"]["checktx"]["count"] == 1    # only the new one
+    assert w["1s"]["checktx"]["p50_ms"] == 2.0
+    assert w["60s"]["checktx"]["count"] == 2   # both
+    assert snap["stages"]["checktx"]["count"] == 2  # cumulative
+
+
+def test_tail_attribution_names_dominant_stage(monkeypatch):
+    _enable(monkeypatch)
+    now = [0]
+    t = _mk_tracker(now)
+    for i in range(40):
+        tx = b"tx-%d" % i
+        t.admit(tx)
+        now[0] += 1_000_000
+        t.mark(tx, "checktx")
+        # the commit leg dominates, and the slowest txs are commit-heavy
+        now[0] += (100 + 10 * i) * 1_000_000
+        t.mark(tx, "commit", height=i + 1)
+        now[0] += 1_000_000
+        t.mark(tx, "publish")
+        now[0] += 1_000_000
+        t.mark(tx, "deliver")
+    att = t.tail_attribution()
+    assert att["ready"] is True
+    assert att["dominant_stage"] == "commit"
+    assert att["tail_count"] >= 1
+    assert att["heights"], "tail heights must be joinable"
+    assert att["mean_leg_ms"]["commit"] > att["mean_leg_ms"]["checktx"]
+
+
+def test_merge_snapshots_is_weighted_union(monkeypatch):
+    _enable(monkeypatch)
+    now = [0]
+    docs = []
+    for node, ms in (("a", 10), ("b", 30)):
+        t = _mk_tracker(now)
+        t.admit(b"tx-" + node.encode())
+        now[0] += ms * 1_000_000
+        t.mark(b"tx-" + node.encode(), "commit", height=1)
+        d = t.snapshot(sketches=True)
+        d["node"] = node
+        docs.append(d)
+    merged = slo.merge_snapshots(docs)
+    assert merged["nodes"] == ["a", "b"]
+    assert merged["sampled_total"] == 2
+    assert merged["stages"]["e2e_commit"]["count"] == 2
+    assert merged["stages"]["e2e_commit"]["p999_ms"] == 30.0
+    # a disabled node is skipped, not merged as zeros
+    merged2 = slo.merge_snapshots(docs + [{"enabled": False}])
+    assert merged2["sampled_total"] == 2
+
+
+def test_slo_report_cli_on_files(tmp_path, monkeypatch, capsys):
+    _enable(monkeypatch)
+    now = [0]
+    t = _mk_tracker(now)
+    for i in range(3):
+        tx = b"r-%d" % i
+        t.admit(tx)
+        now[0] += 5_000_000
+        t.mark(tx, "commit", height=1)
+    doc = t.snapshot(sketches=True)
+    doc["node"] = "filenode"
+    p = tmp_path / "slo0.json"
+    p.write_text(json.dumps(doc))
+    import slo_report
+    out = tmp_path / "report.json"
+    rc = slo_report.main(["--files", str(p), "--report", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "e2e_commit" in text and "3 sampled" in text
+    rep = json.loads(out.read_text())
+    assert rep["merged"]["stages"]["e2e_commit"]["count"] == 3
+    assert rep["per_node"][0]["node"] == "filenode"
+    # a plane-off node is skipped loudly, and no nodes -> rc 1
+    off = tmp_path / "off.json"
+    off.write_text(json.dumps({"enabled": False, "node": "off"}))
+    assert slo_report.main(["--files", str(off)]) == 1
+
+
+# --------------------------------------------------- operational plane
+
+def test_slo_route_healthz_and_call_label_over_http(monkeypatch):
+    """Loop mode end to end: GET /slo serves the table, the `slo`
+    JSON-RPC route honors sketches=true, /healthz folds the verdict,
+    and tm_rpc_call_seconds carries the {route} label."""
+    _enable(monkeypatch)
+    from tendermint_tpu.p2p.conn.loop import ReactorLoop
+    from tendermint_tpu.rpc.core import RPCEnv, make_server
+
+    tx = b"http-tx"
+    slo.admit(tx)
+    slo.mark(tx, "checktx")
+    slo.mark(tx, "commit", height=4)
+
+    loop = ReactorLoop(name="slo-test-loop")
+    server, _core = make_server(RPCEnv(), loop=loop)
+    host, port = server.serve("127.0.0.1", 0)
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/slo", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["enabled"] is True
+        assert doc["sampled_total"] == 1
+        assert doc["stages"]["e2e_commit"]["count"] == 1
+        assert "sketches" not in doc
+
+        from tendermint_tpu.rpc.client import JSONRPCClient
+        c = JSONRPCClient(f"http://{host}:{port}")
+        rich = c.call("slo", sketches=True)
+        assert rich["sketches"]["e2e_commit"]
+
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10) as r:
+            hz = json.loads(r.read())
+        assert hz["slo"]["enabled"] is True
+        assert hz["slo"]["ok"] is True and hz["ok"] is True
+
+        # the route label: the JSON-RPC `slo` call above was timed
+        v = telemetry.value("rpc_call_seconds", {"route": "slo"})
+        assert v is not None and v["count"] >= 1
+        # unknown methods collapse into one label value
+        try:
+            c.call("no_such_route")
+        except Exception:
+            pass
+        vu = telemetry.value("rpc_call_seconds", {"route": "unknown"})
+        assert vu is not None and vu["count"] >= 1
+    finally:
+        server.stop()
+        loop.stop()
+
+
+def test_healthz_ok_flips_on_slo_degradation(monkeypatch):
+    _enable(monkeypatch)
+    from tendermint_tpu.rpc.core import RPCCore, RPCEnv
+    core = RPCCore(RPCEnv())
+    assert core.healthz()["ok"] is True
+    # saturate the tracker: verdict (and the top-level bit) flip
+    slo.TRACKER.inflight_cap = 2
+    slo.admit(b"a")
+    slo.admit(b"b")
+    try:
+        doc = core.healthz()
+        assert doc["slo"]["ok"] is False
+        assert "tracker_saturated" in doc["slo"]["reasons"]
+        assert doc["ok"] is False
+    finally:
+        slo.TRACKER.inflight_cap = slo.INFLIGHT_CAP
+
+
+def test_rpc_core_broadcast_routes_admit_and_checktx(monkeypatch):
+    """The front-door stamps ride the real RPC handlers: a
+    broadcast_tx_sync admission lands admit + checktx for a sampled
+    tx, and broadcast_tx_batch admits the whole list."""
+    _enable(monkeypatch)
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.proxy import local_client_creator
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.rpc.core import RPCCore, RPCEnv
+    mp = Mempool(local_client_creator(KVStoreApp())(), height=0)
+    core = RPCCore(RPCEnv(mempool=mp))
+    tx = b"front=door"
+    core.broadcast_tx_sync(tx)
+    e = slo.TRACKER._inflight[slo.tx_key(tx)]
+    assert "admit" in e.stamps and "checktx" in e.stamps
+    core.broadcast_tx_batch([b"b1=v".hex(), b"b2=v".hex()])
+    assert slo.TRACKER.sampled_total == 3
+    assert "checktx" in slo.TRACKER._inflight[
+        slo.tx_key(b"b1=v")].stamps
+
+
+def test_metrics_catalog_includes_slo():
+    from tendermint_tpu.analysis.checkers import metrics as mcheck
+    assert "slo" in mcheck.KNOWN_SUBSYSTEMS
+    assert "tendermint_tpu.telemetry.slo" in mcheck.INSTRUMENTED_MODULES
+    assert not mcheck.run(), "metrics lint must stay clean"
+
+
+def test_slo_sample_causal_span_declared():
+    from tendermint_tpu.telemetry.causal import SPAN_CATALOG
+    assert "slo.sample" in SPAN_CATALOG
+
+
+# ------------------------------------------------------------- e2e net
+
+def test_e2e_stage_ordering_two_node_socket_net(tmp_path, monkeypatch):
+    """TM_TPU_SLO=on across a real 2-node TCP net with a live WS
+    subscriber: a tx broadcast through node0's RPC front door reaches
+    every stage, the stamps are monotonic, and /slo over HTTP serves
+    the journey. (Both in-process nodes share the process-global
+    tracker; stamps are first-wins, so ordering still holds.)"""
+    monkeypatch.setenv("TM_TPU_SLO", "on")
+    monkeypatch.setenv("TM_TPU_SLO_SAMPLE", "1.0")
+    slo.reset()
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.client import JSONRPCClient, WSClient
+    from tendermint_tpu.rpc.core import RPCEnv, make_server
+    from tendermint_tpu.types import (GenesisDoc, GenesisValidator,
+                                      PrivKey)
+    from tendermint_tpu.types.priv_validator import (LocalSigner,
+                                                     PrivValidator)
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(2)]
+    gen = GenesisDoc(chain_id="slo-net", genesis_time_ns=1,
+                     validators=[GenesisValidator(k.pubkey.ed25519, 10)
+                                 for k in keys])
+    nodes = []
+    for i, k in enumerate(keys):
+        cfg = make_test_config(str(tmp_path / f"node{i}"))
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.addr_book_strict = False
+        nodes.append(Node(cfg, gen,
+                          priv_validator=PrivValidator(LocalSigner(k)),
+                          in_memory=True, with_p2p=True))
+    server = ws = None
+    try:
+        for n in nodes:
+            n.start()
+        nodes[1].switch.dial_peer(nodes[0].switch.listen_address)
+        server, _core = make_server(RPCEnv.from_node(nodes[0]),
+                                    loop=nodes[0].loop)
+        host, port = server.serve("127.0.0.1", 0)
+        ws = WSClient(host, port)
+        ws.subscribe("tm.event = 'Tx'")
+        tx = b"slo-e2e=1"
+        key = slo.tx_key(tx)
+        JSONRPCClient(f"http://{host}:{port}").call(
+            "broadcast_tx_sync", tx=tx)
+        ev = ws.next_event(timeout=60.0)
+        assert ev["tags"]["tx.hash"] == key
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                not any(r["hash"] == key[:16]
+                        for r in slo.TRACKER._completed):
+            time.sleep(0.05)
+        rec = next(r for r in slo.TRACKER._completed
+                   if r["hash"] == key[:16])
+        # the full journey, in order, with every leg non-negative
+        assert set(rec["legs_ms"]) == {"checktx", "propose", "commit",
+                                       "publish", "deliver"}
+        assert all(ms >= 0 for ms in rec["legs_ms"].values())
+        assert rec["h"] >= 1
+        assert slo.TRACKER.monotonic_violations == 0
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/slo", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["completed_total"] >= 1
+        assert doc["stages"]["e2e_delivery"]["count"] >= 1
+    finally:
+        if ws is not None:
+            ws.close()
+        if server is not None:
+            server.stop()
+        for n in nodes:
+            n.stop()
