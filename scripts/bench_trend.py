@@ -112,6 +112,16 @@ EXTRACTORS = (
      "stages.e2e_commit.p99_ms", "ms", "down"),
     ("slo_delivery_p99_ms", "BENCH_slo.json",
      "stages.e2e_delivery.p99_ms", "ms", "down"),
+    # the ISSUE-15 shard plane: aggregate commit rate and the coalesce
+    # factor at 8 chains in one process — the paper's amortization
+    # claim (concurrent sub-threshold verifies from many chains merge
+    # into bigger device batches) as a gated number; regressions mean
+    # the shard plane got slower or cross-chain coalescing stopped
+    # engaging
+    ("shard_agg_blocks_per_sec_8", "BENCH_shard.json",
+     "curve[n_shards=8].agg_blocks_per_sec", "blocks/sec", "up"),
+    ("shard_coalesce_factor_8", "BENCH_shard.json",
+     "curve[n_shards=8].coalesce_factor", "x", "up"),
     ("mesh_8dev_verifies_per_sec", "BENCH_mesh.json",
      "points[devices=8].verifies_per_sec", "verifies/sec", "up"),
     ("statesync_speedup_vs_replay", "BENCH_sync.json",

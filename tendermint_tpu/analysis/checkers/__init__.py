@@ -6,6 +6,9 @@ invoked separately by scripts/lint.py — `all_checkers()` returns only
 the AST ones so `analysis.run_tree` stays import-light.
 """
 
+from tendermint_tpu.analysis.checkers.ambient import (  # noqa: F401
+    AmbientSingletonChecker,
+)
 from tendermint_tpu.analysis.checkers.asyncblock import (  # noqa: F401
     AsyncBlockingChecker,
 )
@@ -26,4 +29,4 @@ from tendermint_tpu.analysis.checkers.locks import (  # noqa: F401
 def all_checkers():
     return [DeterminismChecker(), LockDisciplineChecker(),
             KnobRegistryChecker(), ExceptionHygieneChecker(),
-            AsyncBlockingChecker()]
+            AsyncBlockingChecker(), AmbientSingletonChecker()]

@@ -31,7 +31,7 @@ KNOWN_SUBSYSTEMS = {
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
     "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
-    "slo",
+    "slo", "shard",
 }
 
 INSTRUMENTED_MODULES = [
@@ -59,6 +59,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.rpc.aserver",        # tm_rpc_* async front door
     "tendermint_tpu.chaos.wire",         # tm_wire_* TCP fault proxy
     "tendermint_tpu.telemetry.slo",      # tm_slo_* tx-lifecycle plane
+    "tendermint_tpu.shard.router",       # tm_shard_* router/height plane
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

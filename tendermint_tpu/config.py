@@ -104,6 +104,12 @@ class BaseConfig:
     # restores the thread-per-connection plane byte-for-byte (the
     # wire-parity / chaos-replay escape hatch). TM_TPU_REACTOR wins.
     reactor: str = "auto"
+    # shard plane (shard/): default chain count a ShardSet(n_shards=
+    # None) assembles — N independent chains in one process behind one
+    # front door, sharing the process-default verifier/coalescer and
+    # one ReactorLoop. 0 keeps the single-chain deployment shape.
+    # TM_TPU_SHARDS wins.
+    shards: int = 0
 
 
 @dataclass

@@ -35,12 +35,15 @@ class Node:
                  priv_validator=None, app=None, client_creator=None,
                  mempool=None, evidence_pool=None, in_memory=False,
                  with_p2p=False, fast_sync=False, with_rpc=False,
-                 wal_readonly=False):
+                 wal_readonly=False, loop=None):
         from tendermint_tpu.utils.log import get_logger
         # logging is configured once at the CLI entry point; constructing
         # a Node (tests build several in-process) must not reconfigure
-        # the process-global handler/levels
-        self.logger = get_logger("node")
+        # the process-global handler/levels. The chain id rides as a
+        # logger FIELD, not a process-global bind — a shard plane runs
+        # many chains in one process and their lines must stay
+        # distinguishable (ISSUE 15 value-scoping).
+        self.logger = get_logger("node", chain=gen_doc.chain_id)
         self.config = config
         self.gen_doc = gen_doc
 
@@ -77,7 +80,11 @@ class Node:
         # p2p switch or RPC listener actually needs one.
         from tendermint_tpu.p2p.conn import loop as _loop_cfg
         _loop_cfg.configure(mode=getattr(config.base, "reactor", "auto"))
-        self.loop = None
+        # `loop=` injects a SHARED ReactorLoop (the shard plane runs N
+        # nodes + one RPC front door on one selector); a node only
+        # stops a loop it created itself.
+        self.loop = loop
+        self._owns_loop = loop is None
 
         # causal tracing plane (env TM_TPU_TRACE wins inside enabled();
         # off = untraced wire bytes + zero span recording). The node id
@@ -177,13 +184,20 @@ class Node:
         if (vb, vm, vc, vc_wait, vc_max) == \
                 ("auto", "auto", "auto", 2.0, 0):
             # all-default: share the process-wide verifier — in-process
-            # testnets then coalesce vote verification ACROSS nodes,
-            # exactly the aggregate-arrival-rate win the coalescer is for
+            # testnets and the shard plane then coalesce vote
+            # verification ACROSS chains, exactly the aggregate-
+            # arrival-rate win the coalescer is for. Ownership is
+            # recorded HERE, at construction: comparing against the
+            # module global at stop() time would close the shared
+            # verifier out from under sibling shards the moment anyone
+            # called set_default_verifier() in between.
             self.verifier = default_verifier()
+            self._owns_verifier = False
         else:
             self.verifier = BatchVerifier(
                 vb, mesh=vm, coalesce=vc, coalesce_wait_ms=vc_wait,
                 coalesce_max_batch=vc_max or None)
+            self._owns_verifier = True
 
         # a state-sync restore a crash tore mid-apply is repaired HERE,
         # before the handshake reads the stores (the apply is
@@ -561,18 +575,22 @@ class Node:
                 self.trust_store.save()
         else:
             self.consensus.stop()
-        if self.loop is not None:
-            # after the switch: peer teardowns run ON the loop
+        if self.loop is not None and self._owns_loop:
+            # after the switch: peer teardowns run ON the loop. A
+            # shared (injected) loop belongs to its creator — the
+            # shard set stops it once, after every node is down.
             self.loop.stop()
         if hasattr(self.mempool, "close"):
             self.mempool.close()
         self.app_conns.close()
         if hasattr(self.wal, "close"):
             self.wal.close()
-        # only a verifier this node OWNS: the shared default verifier's
-        # coalescer keeps serving the process's other nodes
-        from tendermint_tpu.models import verifier as _verifier_mod
-        if self.verifier is not _verifier_mod._default:
+        # only a verifier this node OWNS (recorded at construction):
+        # the shared default verifier's coalescer keeps serving the
+        # process's other nodes/shards regardless of any later
+        # set_default_verifier() swap, and shards stopping in
+        # arbitrary order can never close it out from under siblings
+        if self._owns_verifier:
             self.verifier.close()
 
     @property

@@ -74,10 +74,17 @@ _m_events_sent = telemetry.counter(
 # labelled by route so the SLO plane's tail attribution can separate
 # broadcast_tx_* admission cost from query traffic; unregistered
 # method names collapse into one "unknown" label (clients control the
-# method string — it must not mint unbounded label values)
+# method string — it must not mint unbounded label values). The chain
+# label is SERVER-resolved (a shard front door's chain_resolver maps
+# the call onto its key-space routing table; single-chain servers
+# leave it ""): clients cannot mint chain values either, so the SLO
+# plane reads per-shard at bounded cardinality.
 _m_call_seconds = telemetry.histogram(
-    "rpc_call_seconds", "Handler wall time per JSON-RPC call, by route",
-    ("route",), buckets=(1e-4, 1e-3, 5e-3, 2.5e-2, 1e-1, 1.0, 10.0))
+    "rpc_call_seconds",
+    "Handler wall time per JSON-RPC call, by route and (sharded "
+    "front doors) chain",
+    ("route", "chain"), buckets=(1e-4, 1e-3, 5e-3, 2.5e-2, 1e-1, 1.0,
+                                 10.0))
 
 DEFAULT_MAX_CONNS = 4096
 WORKERS = 6
@@ -130,6 +137,10 @@ class AsyncRPCServer:
         self._inflight = 0                       # loop-thread only
         self._stopped = False
         self._tx_batcher = None   # set by make_server; closed on stop
+        # bounded chain-label provider for tm_rpc_call_seconds: a shard
+        # front door (shard/router.py) installs its mapping-backed
+        # resolver here; None (single-chain) labels chain=""
+        self.chain_resolver: Optional[Callable] = None
         # event-render cache: one EventBus.publish fans the SAME
         # (tags, data) objects out to every matching subscriber — at
         # thousands of subscribers, re-encoding the payload per
@@ -285,6 +296,12 @@ class AsyncRPCServer:
         tele = telemetry.enabled()
         route = method if isinstance(method, str) and \
             method in self.funcs else "unknown"
+        chain = ""
+        if tele and self.chain_resolver is not None:
+            try:
+                chain = self.chain_resolver(method, params) or ""
+            except Exception:
+                chain = ""   # label resolution must never fail a call
 
         def work():
             t0 = time.perf_counter() if tele else 0.0
@@ -294,7 +311,7 @@ class AsyncRPCServer:
             except RPCError as e:
                 resp = _rpc_response(id_, error=e)
             if tele:
-                _m_call_seconds.labels(route).observe(
+                _m_call_seconds.labels(route, chain).observe(
                     time.perf_counter() - t0)
             self.loop.call_soon(lambda: self._complete(send, resp),
                                 owner="rpc")

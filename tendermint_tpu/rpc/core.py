@@ -169,6 +169,11 @@ class RPCCore:
         self.tx_batcher: Optional[TxBatcher] = None
         self._profiler = None
         self._profiler_lock = threading.Lock()
+        # shard attribution for the SLO plane: the chain this core
+        # serves, stamped on every admit. Bounded by construction —
+        # it is OUR genesis chain id (one value per core; a shard
+        # front door runs one core per chain), never a client string.
+        self._chain = env.gen_doc.chain_id if env.gen_doc else ""
 
     def enable_tx_batching(self) -> None:
         """Async front door: coalesce concurrent broadcast_tx
@@ -433,7 +438,7 @@ class RPCCore:
         batcher (async server) the tx rides the next merged
         check_tx_batch; the threaded path keeps its one-off thread."""
         import hashlib
-        slo_obs.admit(tx)
+        slo_obs.admit(tx, chain=self._chain)
         if self.tx_batcher is not None:
             self.tx_batcher.submit(tx, wait=False)
         else:
@@ -450,7 +455,7 @@ class RPCCore:
     def broadcast_tx_sync(self, tx: bytes) -> dict:
         """Wait for CheckTx result (rpc/core/mempool.go:91)."""
         import hashlib
-        slo_obs.admit(tx)
+        slo_obs.admit(tx, chain=self._chain)
         res = self._check_tx(tx)
         return jsonify({"code": res.code, "data": res.data,
                         "log": res.log,
@@ -470,7 +475,7 @@ class RPCCore:
                    for t in txs]
         except (ValueError, AttributeError) as e:
             raise RPCError(-32602, f"bad tx hex: {e}") from e
-        slo_obs.admit_many(raw)
+        slo_obs.admit_many(raw, chain=self._chain)
         mp = self.env.mempool
         if hasattr(mp, "check_tx_batch"):
             results = mp.check_tx_batch(raw)
@@ -495,7 +500,7 @@ class RPCCore:
         (rpc/core/mempool.go:109): subscribe to EventTx for this hash
         BEFORE submitting, then block on delivery."""
         import hashlib
-        slo_obs.admit(tx)
+        slo_obs.admit(tx, chain=self._chain)
         bus = self.env.event_bus
         tx_hash = hashlib.sha256(tx).hexdigest().upper()
         subscriber = f"bcast-{tx_hash[:16]}-{time.monotonic_ns()}"

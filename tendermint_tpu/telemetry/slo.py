@@ -75,8 +75,11 @@ SKETCH_CAP = 512
 _m_stage = telemetry.summary(
     "slo_stage_seconds",
     "Per-transaction lifecycle leg latency (sampled txs), by the stage "
-    "that closes the leg; e2e_commit/e2e_delivery are admit-anchored",
-    ("stage",), quantiles=QUANTILES, cap=SKETCH_CAP)
+    "that closes the leg; e2e_commit/e2e_delivery are admit-anchored. "
+    "The chain label is shard attribution: stamped at admit by the "
+    "server-side router/core (bounded — never a client string), \"\" "
+    "for gossip-arrived or unsharded traffic",
+    ("stage", "chain"), quantiles=QUANTILES, cap=SKETCH_CAP)
 _m_sampled = telemetry.counter(
     "slo_sampled_total", "Transactions admitted into the SLO tracker")
 _m_completed = telemetry.counter(
@@ -149,11 +152,12 @@ def tx_key(tx: bytes) -> str:
 
 
 class _Entry:
-    __slots__ = ("stamps", "height")
+    __slots__ = ("stamps", "height", "chain")
 
-    def __init__(self, t_ns: int):
+    def __init__(self, t_ns: int, chain: str = ""):
         self.stamps: Dict[str, int] = {"admit": t_ns}
         self.height = 0
+        self.chain = chain
 
 
 class _Series:
@@ -190,6 +194,10 @@ class SLOTracker:
         self._ops_since_sweep = 0
         self.sampled_total = 0
         self.completed_total = 0
+        # shard attribution (ISSUE 15): per-chain sampled/completed
+        # counts — keys only ever come from server-side admit(chain=)
+        self.sampled_by_chain: Dict[str, int] = {}
+        self.completed_by_chain: Dict[str, int] = {}
         # overflow: evicted by the in-flight cap; timeout: expired
         # before COMMITTING (a real SLO failure); undelivered: expired
         # after committing (no Tx subscriber was listening — accounted,
@@ -200,8 +208,11 @@ class SLOTracker:
 
     # ------------------------------------------------------------ stamps
 
-    def admit(self, tx: bytes) -> None:
-        """Front-door admission (broadcast_tx_* entry)."""
+    def admit(self, tx: bytes, chain: str = "") -> None:
+        """Front-door admission (broadcast_tx_* entry). `chain` is
+        shard attribution, supplied by the SERVER (the router's
+        mapping or the core's own genesis chain id — bounded, never a
+        client-minted string)."""
         if not enabled():
             return
         digest = hashlib.sha256(tx).digest()
@@ -215,17 +226,20 @@ class SLOTracker:
             while len(self._inflight) >= self.inflight_cap:
                 old_key, old = self._inflight.popitem(last=False)
                 self._account_drop("overflow", old, now)
-            self._inflight[key] = _Entry(now)
+            self._inflight[key] = _Entry(now, chain)
             self.sampled_total += 1
+            if chain:
+                self.sampled_by_chain[chain] = \
+                    self.sampled_by_chain.get(chain, 0) + 1
             self._maybe_sweep(now)
         _m_sampled.inc()
         _m_inflight.set(len(self._inflight))
 
-    def admit_many(self, txs) -> None:
+    def admit_many(self, txs, chain: str = "") -> None:
         if not enabled():
             return
         for tx in txs:
-            self.admit(tx)
+            self.admit(tx, chain=chain)
 
     def mark(self, tx: bytes, stage: str, height: int = 0) -> None:
         if not enabled() or not self._inflight:
@@ -255,10 +269,12 @@ class SLOTracker:
         now_s = now / 1e9
         legs: List[tuple] = []
         done = None
+        chain = ""
         with self._lock:
             e = self._inflight.get(key)
             if e is None or stage in e.stamps:
                 return
+            chain = e.chain
             prev_t = None
             for s in STAGES[idx - 1::-1]:
                 if s in e.stamps:
@@ -280,7 +296,7 @@ class SLOTracker:
                 self._series[name].observe(now_s, dur_ns / 1e9)
             self._maybe_sweep(now)
         for name, dur_ns in legs:
-            _m_stage.labels(name).observe(dur_ns / 1e9)
+            _m_stage.labels(name, chain).observe(dur_ns / 1e9)
         if done is not None:
             _m_completed.inc()
             _m_inflight.set(len(self._inflight))
@@ -306,6 +322,9 @@ class SLOTracker:
         """_lock held. Move a delivered tx to the completed ring."""
         self._inflight.pop(key, None)
         self.completed_total += 1
+        if e.chain:
+            self.completed_by_chain[e.chain] = \
+                self.completed_by_chain.get(e.chain, 0) + 1
         admit = e.stamps["admit"]
         legs_ms = {}
         prev = admit
@@ -392,6 +411,12 @@ class SLOTracker:
                 "timeout_last_stage": dict(self.timeout_last_stage),
                 "monotonic_violations": self.monotonic_violations,
             }
+            if self.sampled_by_chain:
+                doc["chains"] = {
+                    chain: {"sampled": n,
+                            "completed":
+                                self.completed_by_chain.get(chain, 0)}
+                    for chain, n in sorted(self.sampled_by_chain.items())}
             sketch_items = {name: s.sketch.items()
                             for name, s in self._series.items()}
             counts = {name: s.sketch.count
@@ -521,6 +546,8 @@ class SLOTracker:
             self._ops_since_sweep = 0
             self.sampled_total = 0
             self.completed_total = 0
+            self.sampled_by_chain = {}
+            self.completed_by_chain = {}
             self.dropped = {"overflow": 0, "timeout": 0,
                             "undelivered": 0}
             self.timeout_last_stage = {}
@@ -533,12 +560,12 @@ TRACKER = SLOTracker()
 
 # module-level conveniences (the call-site surface)
 
-def admit(tx: bytes) -> None:
-    TRACKER.admit(tx)
+def admit(tx: bytes, chain: str = "") -> None:
+    TRACKER.admit(tx, chain=chain)
 
 
-def admit_many(txs) -> None:
-    TRACKER.admit_many(txs)
+def admit_many(txs, chain: str = "") -> None:
+    TRACKER.admit_many(txs, chain=chain)
 
 
 def mark(tx: bytes, stage: str, height: int = 0) -> None:

@@ -208,6 +208,13 @@ CATALOG: tuple[Knob, ...] = (
          "A fresh node joins via p2p snapshot restore (statesync/) and "
          "fast-syncs only the tail; off = full block replay.",
          "statesync/reactor.py"),
+    # -- shard plane -------------------------------------------------------
+    Knob("TM_TPU_SHARDS", "int", "0 (off)", "base.shards",
+         "Default chain count a ShardSet assembles: N independent "
+         "chains in one process behind one front door, sharing the "
+         "process-default verifier and one ReactorLoop; 0 = single-"
+         "chain shape.",
+         "shard/__init__.py"),
     # -- chaos plane -------------------------------------------------------
     Knob("TM_TPU_CHAOS", "spec", "off", "base.chaos",
          "Link fault spec, e.g. drop=0.05,delay=0.1,delay_ms=30,seed=7.",

@@ -220,6 +220,82 @@ def test_exceptions_allows_logged_narrow_or_unlooped():
         assert lint_source(src) == []
 
 
+# ---------------------------------------------------- ambient-singleton --
+
+def test_ambient_flags_global_rebind():
+    """ISSUE 15 ratchet: a module-level name a function rebinds via
+    `global` is an ambient process singleton — a finding unless
+    blessed in analysis/checkers/ambient.py."""
+    src = (
+        "_default = None\n"
+        "def get_default():\n"
+        "    global _default\n"
+        "    if _default is None:\n"
+        "        _default = object()\n"
+        "    return _default\n"
+    )
+    found = lint_source(src)
+    assert ids(found) == ["ambient-singleton"]
+    assert len(found) == 1 and found[0].line == 1
+    assert "global" in found[0].message
+
+
+def test_ambient_flags_mutated_module_container():
+    src = (
+        "_registry = {}\n"
+        "def register(name, fn):\n"
+        "    _registry[name] = fn\n"
+        "_order = []\n"
+        "def push(x):\n"
+        "    _order.append(x)\n"
+    )
+    found = lint_source(src)
+    assert ids(found) == ["ambient-singleton"]
+    assert sorted(f.line for f in found) == [1, 4]
+
+
+def test_ambient_allows_readonly_tables_locals_and_blessed():
+    # read-only import-time lookup tables, function locals, class
+    # attributes and constant tuples are NOT ambient singletons
+    clean = (
+        "_LEVELS = {'debug': 10, 'info': 20}\n"
+        "_IDX = {s: i for i, s in enumerate(('a', 'b'))}\n"
+        "NAMES = ('x', 'y')\n"
+        "class Reg:\n"
+        "    table = {}\n"
+        "    def put(self, k, v):\n"
+        "        self.table[k] = v\n"
+        "def lookup(name):\n"
+        "    cache = {}\n"
+        "    cache[name] = _LEVELS.get(name)\n"
+        "    return cache[name]\n"
+    )
+    assert lint_source(clean) == []
+    # a blessed catalog entry stays quiet at its recorded path
+    blessed = (
+        "_default = None\n"
+        "def default_verifier():\n"
+        "    global _default\n"
+        "    _default = _default or object()\n"
+        "    return _default\n"
+    )
+    assert lint_source(
+        blessed, rel="tendermint_tpu/models/verifier.py") == []
+    # ...but the SAME code in a new module is a finding (the ratchet)
+    assert len(lint_source(
+        blessed, rel="tendermint_tpu/shard/newmod.py")) == 1
+
+
+def test_ambient_pragma_suppresses_at_binding():
+    src = (
+        "_cache = {}  # tmlint: allow(ambient-singleton): bounded "
+        "LRU, reset() in tests\n"
+        "def put(k, v):\n"
+        "    _cache[k] = v\n"
+    )
+    assert lint_source(src) == []
+
+
 # -------------------------------------------------------------- metrics --
 
 def test_metrics_checker_flags_bad_family():

@@ -385,14 +385,18 @@ def test_slo_route_healthz_and_call_label_over_http(monkeypatch):
         assert hz["slo"]["ok"] is True and hz["ok"] is True
 
         # the route label: the JSON-RPC `slo` call above was timed
-        v = telemetry.value("rpc_call_seconds", {"route": "slo"})
+        # (chain="" — this is a single-chain server; a shard front
+        # door's chain_resolver fills it, tests/test_shard.py)
+        v = telemetry.value("rpc_call_seconds",
+                            {"route": "slo", "chain": ""})
         assert v is not None and v["count"] >= 1
         # unknown methods collapse into one label value
         try:
             c.call("no_such_route")
         except Exception:
             pass
-        vu = telemetry.value("rpc_call_seconds", {"route": "unknown"})
+        vu = telemetry.value("rpc_call_seconds",
+                             {"route": "unknown", "chain": ""})
         assert vu is not None and vu["count"] >= 1
     finally:
         server.stop()
