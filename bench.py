@@ -1961,6 +1961,200 @@ def bench_shard_json(path: str = "BENCH_shard.json",
     return doc
 
 
+def bench_state_json(path: str = "BENCH_state.json") -> dict:
+    """BENCH_state.json (ISSUE 16): the authenticated state tree's
+    cost surface — per-key commit cost vs state size (incremental
+    dirty-subtree rehash vs a naive whole-state rehash), proof
+    size/verify cost, a GB-scale cold join streamed through
+    snapshot_items/restore_items, and one end-to-end certified read
+    with a forged counterexample."""
+    import time as _time
+
+    from tendermint_tpu import statetree
+    from tendermint_tpu.statetree import StateTree
+
+    sizes = tuple(int(s) for s in os.environ.get(
+        "TM_BENCH_STATE_SIZES", "10000,100000,1000000").split(","))
+    wave = 1024
+    curve = []
+    proof_stats = None
+    for n in sizes:
+        print(f"[bench] state arm n={n}...", file=sys.stderr,
+              flush=True)
+        tree = StateTree()
+        t0 = _time.perf_counter()
+        for i in range(n):
+            tree.set(b"k/%012d" % i, b"v/%024d" % i)
+        build_insert_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        tree.commit(1)
+        # the first commit hashes EVERY node (2n-1): exactly the work
+        # a naive whole-state rehash would redo for any write wave —
+        # the honest measured control for the incremental path
+        full_rehash_s = _time.perf_counter() - t0
+        wave_s = []
+        for w in range(3):
+            for i in range(wave):
+                j = (i * 7919 + w * 104729) % n
+                tree.set(b"k/%012d" % j, b"w/%d/%d" % (w, i))
+            t0 = _time.perf_counter()
+            tree.commit(2 + w)
+            wave_s.append(_time.perf_counter() - t0)
+        wave_commit_s = sorted(wave_s)[1]  # median of 3
+        curve.append({
+            "keys": n,
+            "wave_keys": wave,
+            "us_per_key": wave_commit_s / wave * 1e6,
+            "naive_rehash_us_per_key": full_rehash_s / wave * 1e6,
+            "speedup_vs_naive_rehash": full_rehash_s / wave_commit_s,
+            "build_insert_s": build_insert_s,
+            "full_rehash_s": full_rehash_s,
+        })
+        if n == max(sizes):
+            version = 1 + len(wave_s)
+            samples = 200
+            sizes_b, depths = [], []
+            proofs = []
+            for i in range(samples):
+                key = b"k/%012d" % ((i * 4999) % n)
+                value, pf = tree.prove(key, version)
+                raw = statetree.proof_to_bytes(pf)
+                sizes_b.append(len(raw))
+                depths.append(len(pf.steps))
+                proofs.append((key, value, raw))
+            root = tree.app_hash_at(version)
+            t0 = _time.perf_counter()
+            for key, value, raw in proofs:
+                statetree.verify(statetree.proof_from_bytes(raw),
+                                 key, value, root)
+            verify_s = _time.perf_counter() - t0
+            proof_stats = {
+                "keys": n,
+                "samples": samples,
+                "bytes_mean": sum(sizes_b) / samples,
+                "bytes_max": max(sizes_b),
+                "depth_mean": sum(depths) / samples,
+                "verify_us": verify_s / samples * 1e6,
+            }
+        del tree
+
+    # ---- GB-scale cold join: stream a snapshot into a fresh app ----
+    from tendermint_tpu.abci.apps import KVStoreApp
+    n_cold = int(os.environ.get("TM_BENCH_STATE_COLDJOIN_KEYS",
+                                "1000000"))
+    value_bytes = 1024
+    prev_knob = os.environ.get("TM_TPU_STATE_TREE")
+    os.environ["TM_TPU_STATE_TREE"] = "on"
+    try:
+        print(f"[bench] state cold join: {n_cold} keys x "
+              f"{value_bytes}B...", file=sys.stderr, flush=True)
+        src = KVStoreApp()
+        for i in range(n_cold):
+            src.store[b"cold/%012d" % i] = (b"%016d" % i) * \
+                (value_bytes // 16)
+        src_hash = src.commit()
+        dst = KVStoreApp()
+        t0 = _time.perf_counter()
+        restored = dst.restore_items(src.snapshot_items(), 1, None)
+        restore_s = _time.perf_counter() - t0
+        cold_join = {
+            "keys": n_cold,
+            "value_bytes": value_bytes,
+            "state_gb": n_cold * value_bytes / 1e9,
+            "restore_s": restore_s,
+            "keys_per_s": n_cold / restore_s,
+            "app_hash_match": restored == src_hash,
+            "streamed": "snapshot_items is a tree-node iterator; the "
+                        "source state is never materialized twice",
+        }
+        assert cold_join["app_hash_match"], "cold join diverged"
+        del src, dst
+
+        # ---- end-to-end certified read + forged counterexample ----
+        print("[bench] certified read e2e...", file=sys.stderr,
+              flush=True)
+        from tendermint_tpu.shard import (
+            ReadProofError,
+            ShardSet,
+            reads,
+        )
+        s = ShardSet(2, chain_prefix="benchstate")
+        s.start()
+        try:
+            deadline = _time.monotonic() + 60
+            while s.frontier() < 2 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            key = b"bench/certified"
+            node = s.node_for_key(key)
+            node.mempool.check_tx(key + b"=proven")
+            value_seen = False
+            while _time.monotonic() < deadline and not value_seen:
+                h = node.block_store.height()
+                if h >= 2:
+                    res = node.app_conns.query.query(
+                        "", key, height=h - 1, prove=True)
+                    value_seen = res.code == 0 and \
+                        res.value == b"proven"
+                if not value_seen:
+                    _time.sleep(0.05)
+            reader = s.reader()
+            res = reader.read(key)
+            orig = reads.serve_read
+
+            def forge(nd, k, since, **kw):
+                d = orig(nd, k, since, **kw)
+                d["value_proof"]["n_keys"] += 1
+                return d
+
+            reads.serve_read = forge
+            forged_rejected = False
+            try:
+                reader.read(key)
+            except ReadProofError:
+                forged_rejected = True
+            finally:
+                reads.serve_read = orig
+            certified = {
+                "chain_id": res["chain_id"],
+                "value": res["value"].decode(),
+                "proven": bool(res["proven"]),
+                "value_height": res["value_height"],
+                "certified_height": res["certified_height"],
+                "forged_rejected": forged_rejected,
+            }
+        finally:
+            s.stop()
+    finally:
+        if prev_knob is None:
+            os.environ.pop("TM_TPU_STATE_TREE", None)
+        else:
+            os.environ["TM_TPU_STATE_TREE"] = prev_knob
+
+    big = curve[-1]
+    doc = {
+        "metric": "state_tree",
+        "source": "bench.py --state-json: critbit Merkle state tree "
+                  "(tendermint_tpu/statetree/, docs/state.md) — "
+                  "1024-key write waves committed against growing "
+                  "state; the naive control is the measured full "
+                  "rehash of the same tree (what any whole-state "
+                  "backend redoes per block). The bucket-accumulator "
+                  "backend stays O(1)/key but offers no per-key "
+                  "proofs — the tree buys proofs at O(log n)/key.",
+        "commit_curve": curve,
+        "sublinear_at_1m": big["us_per_key"] <
+        10 * curve[0]["us_per_key"],
+        "incremental_beats_naive_rehash_5x_at_largest":
+            big["speedup_vs_naive_rehash"] >= 5.0,
+        "proof": proof_stats,
+        "cold_join": cold_join,
+        "certified_read_e2e": certified,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def main() -> int:
     import numpy as np
     import jax
@@ -2392,6 +2586,12 @@ if __name__ == "__main__":
         # (1/8/32-chain shard plane scaling curve + certified
         # cross-shard reads + AppHash parity vs single-chain controls)
         print(json.dumps(bench_shard_json()), flush=True)
+        sys.exit(0)
+    if "--state-json" in sys.argv:
+        # standalone quick mode: only the BENCH_state.json satellite
+        # (authenticated state tree: commit cost curve, proof costs,
+        # GB-scale cold join, certified read + forged counterexample)
+        print(json.dumps(bench_state_json()), flush=True)
         sys.exit(0)
     if "--coalesce-json" in sys.argv:
         # standalone quick mode: only the BENCH_coalesce.json satellite

@@ -126,6 +126,13 @@ EXTRACTORS = (
      "points[devices=8].verifies_per_sec", "verifies/sec", "up"),
     ("statesync_speedup_vs_replay", "BENCH_sync.json",
      "speedup_statesync_vs_replay", "x", "up"),
+    # the ISSUE-16 authenticated state tree: per-key commit cost at
+    # 1M keys (sub-linear in state size is the whole point) and the
+    # client-side proof verification cost a certified read pays
+    ("state_commit_us_per_key_1m", "BENCH_state.json",
+     "commit_curve[keys=1000000].us_per_key", "us", "down"),
+    ("state_proof_verify_us", "BENCH_state.json",
+     "proof.verify_us", "us", "down"),
     ("height_wall_p50_ms", "BENCH_trace.json",
      "attribution.per_height[-1].wall_ms", "ms", "down"),
 )

@@ -10,8 +10,14 @@ comes to dominate long syncs. The reference's dummy gets
 incrementality from its IAVL tree; the hash value itself is
 app-defined in both builds. (Additive set-hashing trades collision
 margin for O(1) updates — the known generalized-birthday attacks need
-~2^80+ work per bucket, acceptable for this demo app; swap in an IAVL
-module if an application needs stronger commitments or range proofs.)
+~2^80+ work per bucket, acceptable for this demo app.)
+
+TM_TPU_STATE_TREE=on swaps the commit backend for the authenticated
+state tree (tendermint_tpu/statetree/, docs/state.md): app_hash
+becomes a critbit Merkle root, `query(prove=True)` returns per-key
+inclusion/absence proofs bound to it, and snapshot chunks stream
+straight from tree nodes. The two backends produce DIFFERENT app
+hashes by design — every validator of a chain must agree on the knob.
 
 Validator-change txs (the reference's persistent_dummy surface):
 `val:<pubkey_hex>/<power>` queues a validator update returned from
@@ -86,8 +92,78 @@ class _NativeStoreView:
         return iter(self.keys())
 
 
+class _TreeStoreView:
+    """Mapping facade over a StateTree so every caller of `app.store`
+    (deliver_tx writes, query/info reads, tests doing dict(app.store))
+    sees the same dict-like surface the other two cores expose. Reads
+    hit the WORKING tree (pre-commit state, same semantics as the dict
+    path); versioned/proven reads go through the tree directly."""
+
+    def __init__(self, tree):
+        self._tree = tree
+
+    def get(self, k, default=None):
+        v = self._tree.get(k)
+        return default if v is None else v
+
+    def __getitem__(self, k):
+        v = self._tree.get(k)
+        if v is None:
+            raise KeyError(k)
+        return v
+
+    def __setitem__(self, k, v):
+        self._tree.set(k, v)
+
+    def __delitem__(self, k):
+        if not self._tree.delete(k):
+            raise KeyError(k)
+
+    def __contains__(self, k):
+        return self._tree.get(k) is not None
+
+    def __len__(self):
+        return len(self._tree)
+
+    def __bool__(self):
+        return len(self._tree) > 0
+
+    def items(self):
+        # live iteration: walk the working root under the tree lock
+        with self._tree._lock:
+            stack = [self._tree._root] if self._tree._root is not None \
+                else []
+            out = []
+            while stack:
+                node = stack.pop()
+                if hasattr(node, "key"):
+                    out.append((node.key, node.value))
+                else:
+                    stack.append(node.right)
+                    stack.append(node.left)
+            return out
+
+    def keys(self):
+        return [k for k, _ in self.items()]
+
+    def __iter__(self):
+        return iter(self.keys())
+
+
 class KVStoreApp(BaseApplication):
     def __init__(self, use_native: bool = True):
+        # commit backend selection (ISSUE 16): TM_TPU_STATE_TREE=on
+        # swaps the bucketed accumulator (below) for the authenticated
+        # state tree — per-key proofs bound to app_hash, at the cost of
+        # O(log n) hashing per touched key. The two backends produce
+        # DIFFERENT app hashes by design (pinned by test); all
+        # validators of one chain must agree on the knob.
+        from tendermint_tpu.utils import knobs
+        self._tree = None
+        if knobs.knob_bool("TM_TPU_STATE_TREE"):
+            from tendermint_tpu.statetree import StateTree
+            self._tree = StateTree()
+            use_native = False  # the tree IS the store; no C++ kv core
         # native core (kvcore.cpp): the plain-kv DeliverTx path, the
         # bucketed accumulator, and the commit hash in C++ — the pure
         # Python fields below stay authoritative when it is absent
@@ -99,6 +175,9 @@ class KVStoreApp(BaseApplication):
         if self._kvmod is not None:
             self._core = self._kvmod.kv_new()
             self.store = _NativeStoreView(self._kvmod, self._core)
+        elif self._tree is not None:
+            self._core = None
+            self.store = _TreeStoreView(self._tree)
         else:
             self._core = None
             self.store: dict[bytes, bytes] = {}
@@ -183,7 +262,8 @@ class KVStoreApp(BaseApplication):
             self._kvmod.set_one(self._core, k, v)
         else:
             self.store[k] = v
-            self._dirty.add(k)
+            if self._tree is None:
+                self._dirty.add(k)
         self.tx_count += 1
         return ResultDeliverTx(tags={"app.key": k.decode("utf-8", "replace")})
 
@@ -208,6 +288,12 @@ class KVStoreApp(BaseApplication):
         # state-size independent — see the module docstring for the
         # construction and its tradeoff.
         self.height += 1
+        if self._tree is not None:
+            # authenticated path: rehash the dirty subtree, register
+            # version `height` (the app_hash a header at height+1
+            # carries — provers serve reads against retained versions)
+            self.app_hash = self._tree.commit(self.height)
+            return self.app_hash
         if self._core is not None:
             self.app_hash = self._kvmod.commit(self._core)
             return self.app_hash
@@ -259,9 +345,15 @@ class KVStoreApp(BaseApplication):
     # -- state-sync snapshot surface ------------------------------------------
 
     def snapshot_items(self):
-        """The complete kv state, sorted by key — deterministic across
-        the native and pure-Python cores, so two nodes at the same
-        height publish byte-identical snapshot payloads."""
+        """The complete kv state in a deterministic order, so two
+        nodes at the same height publish byte-identical snapshot
+        payloads. Bucket cores sort by key (a materialized copy); the
+        tree backend STREAMS straight from the committed version's
+        nodes in key-hash order — copy-on-write keeps the iterator a
+        consistent snapshot even while later blocks commit, so
+        GB-scale state never gets a second in-memory copy."""
+        if self._tree is not None:
+            return self._tree.items_at(self.height)
         return sorted(self.store.items())
 
     def restore_items(self, items, height: int, validators=None) -> bytes:
@@ -271,7 +363,19 @@ class KVStoreApp(BaseApplication):
         height bookkeeping lands on exactly `height`). The resulting
         hash MUST match the snapshot state's app_hash — the caller
         verifies and aborts on mismatch."""
-        if self._core is not None:
+        if self._tree is not None:
+            # a fresh tree, replayed through the normal set path; the
+            # commit() below registers version `height` so proofs work
+            # immediately after a state-sync join. A snapshot taken by
+            # a BUCKET-mode chain recomputes to a different app_hash
+            # here and the caller's verify aborts — restoring across
+            # commit backends is a config error, not a silent adopt.
+            from tendermint_tpu.statetree import StateTree
+            self._tree = StateTree()
+            self.store = _TreeStoreView(self._tree)
+            for k, v in items:
+                self.store[bytes(k)] = bytes(v)
+        elif self._core is not None:
             # a fresh native core is cheaper and simpler than clearing
             self._core = self._kvmod.kv_new()
             self.store = _NativeStoreView(self._kvmod, self._core)
@@ -297,6 +401,29 @@ class KVStoreApp(BaseApplication):
 
     def query(self, path: str, data: bytes, height: int,
               prove: bool) -> ResultQuery:
+        if self._tree is not None and (prove or height):
+            # versioned (and optionally proven) read against a
+            # COMMITTED tree version. height 0 = the latest commit.
+            # The proof binds (key, value-or-absence) to that
+            # version's app_hash — the hash the header at height+1
+            # carries, which a lite client can certify.
+            version = int(height) if height else self.height
+            try:
+                if prove:
+                    value, pf = self._tree.prove(data, version)
+                else:
+                    value, pf = self._tree.get(data, version), None
+            except KeyError as e:
+                return ResultQuery(code=1, key=data, height=version,
+                                   log=str(e))
+            proof_bytes = b""
+            if pf is not None:
+                from tendermint_tpu.statetree import proof_to_bytes
+                proof_bytes = proof_to_bytes(pf)
+            return ResultQuery(
+                key=data, value=value or b"", proof=proof_bytes,
+                height=version,
+                log="exists" if value is not None else "does not exist")
         value = self.store.get(data, b"")
         return ResultQuery(key=data, value=value, height=self.height,
                            log="exists" if value else "does not exist")

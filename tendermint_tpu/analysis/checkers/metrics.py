@@ -31,7 +31,7 @@ KNOWN_SUBSYSTEMS = {
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
     "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
-    "slo", "shard",
+    "slo", "shard", "statetree",
 }
 
 INSTRUMENTED_MODULES = [
@@ -60,6 +60,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.chaos.wire",         # tm_wire_* TCP fault proxy
     "tendermint_tpu.telemetry.slo",      # tm_slo_* tx-lifecycle plane
     "tendermint_tpu.shard.router",       # tm_shard_* router/height plane
+    "tendermint_tpu.statetree.store",    # tm_statetree_* commit/proof plane
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

@@ -214,6 +214,11 @@ class ContinuousCertifier:
         self.next_height = next_height
         self.static_certified = 0
         self.updates = 0          # heights crossed via a valset delta
+        # recently certified headers' app hashes, keyed by height — the
+        # anchor a per-key STATE proof verifies against (header h binds
+        # the app state after block h-1). Bounded: certified reads only
+        # ever need the frontier's neighborhood.
+        self.app_hashes: dict = {}
 
     @property
     def certified_height(self) -> int:
@@ -247,6 +252,9 @@ class ContinuousCertifier:
                     f"{e}") from e
             self.validators = fc.validators
             self.updates += 1
+        self.app_hashes[fc.height] = fc.signed_header.header.app_hash
+        while len(self.app_hashes) > 16:
+            self.app_hashes.pop(next(iter(self.app_hashes)))
         self.next_height += 1
 
 

@@ -295,6 +295,60 @@ def tree_proofs_host(items: list[bytes]):
     return root, proofs
 
 
+_SHA_DEVICE_MIN = 512  # payloads below this never pay a device dispatch
+_m_sha_batches = telemetry.counter(
+    "merkle_sha_batches_total", "Batched SHA-256 dispatches", ("impl",))
+
+
+def sha256_many_host(payloads: list) -> list[bytes]:
+    """One SHA-256 digest per payload, batched — the statetree's
+    dirty-node rehash plane (every commit hands its dirty leaf and
+    inner payloads here in level-sized waves). Dispatch policy mirrors
+    root_host: the native C++ batch kernel when present; a device batch
+    only when jax is ALREADY imported in this process, the payloads
+    share one static length, and the batch is big enough to amortize a
+    dispatch; else a hashlib loop."""
+    n = len(payloads)
+    if n == 0:
+        return []
+    if n >= _SHA_DEVICE_MIN:
+        import sys
+        if "jax" in sys.modules:
+            length = len(payloads[0])
+            if all(len(p) == length for p in payloads):
+                out = _sha256_many_device(payloads, n, length)
+                if out is not None:
+                    if telemetry.enabled():
+                        _m_sha_batches.labels("device").inc()
+                    return out
+    from tendermint_tpu import native
+    out = native.sha256_batch([bytes(p) for p in payloads])
+    if out is not None:
+        if telemetry.enabled():
+            _m_sha_batches.labels("native").inc()
+        return out
+    if telemetry.enabled():
+        _m_sha_batches.labels("host").inc()
+    sha = hashlib.sha256
+    return [sha(p).digest() for p in payloads]
+
+
+def _sha256_many_device(payloads, n: int, length: int):
+    """uint8[n, L] batch through ops.sha256.hash_fixed, or None when
+    the device path is unusable (import/backend trouble mid-flight must
+    degrade to the host loop, never fail the commit)."""
+    try:
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import sha256
+        rows = np.frombuffer(b"".join(payloads), np.uint8).reshape(
+            n, length)
+        out = np.asarray(sha256.hash_fixed(jnp.asarray(rows)))
+        return [out[i].tobytes() for i in range(n)]
+    except Exception:
+        return None
+
+
 def verify_proof_host(root: bytes, total: int, index: int, item: bytes,
                       aunts: list[bytes]) -> bool:
     if not (0 <= index < total) or _padded_size(max(total, 1)) != 1 << len(aunts):

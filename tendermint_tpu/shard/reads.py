@@ -14,14 +14,21 @@ chain, mismatched frontier — fails loudly as ``ReadProofError``.
 
 What the proof certifies: that shard B's validator set really
 committed height ``h`` with the returned header (incl. its app_hash).
-Binding the VALUE bytes to that app_hash needs per-key state proofs
-(the incrementally-Merkleized app tree of ROADMAP item 5); until then
-the read is certified to the chain head, and the value is what the
-certified chain's app serves — documented in docs/sharding.md.
+When the serving chain runs the authenticated state tree
+(TM_TPU_STATE_TREE, ISSUE 16) the response ALSO carries a per-key
+state proof at ``value_height = h-1`` — the version whose root the
+certified header at ``h`` binds (state/validation.py pins
+``header.app_hash`` to the PRE-exec state, i.e. the app hash after
+block h-1) — and the client verifies the full chain of custody:
+value -> tree root -> app_hash -> certified commit. Bucket-mode
+chains still certify only the head; the value itself rides untrusted
+(the honest caveat in docs/sharding.md).
 
 The server side (``serve_read``) reads the value at a STABLE height:
 it retries until the shard's frontier is identical before and after
-the app query, so the proof height and the value snapshot agree."""
+the app query, so the proof height and the value snapshot agree. The
+proven read is then served at the FIXED version h-1, which
+copy-on-write keeps consistent regardless of races."""
 
 from __future__ import annotations
 
@@ -81,6 +88,21 @@ def serve_read(node, key: bytes, since_height: int = 0,
         raise ValueError(
             f"since_height {since_height} is ahead of the shard "
             f"frontier {h}")
+    # authenticated value (tree backend): re-serve the value at the
+    # FIXED version h-1 with its state proof — that version's root is
+    # exactly the app_hash the certified header at h carries. h == 1
+    # has no committed version below it (header 1 binds the genesis
+    # app hash), so the first block falls back to the head-only read.
+    value_height = None
+    value_proof = None
+    if h >= 2:
+        res = node.app_conns.query.query("", bytes(key), height=h - 1,
+                                         prove=True)
+        if res.code == 0 and res.proof:
+            import json
+            value = res.value or b""
+            value_height = h - 1
+            value_proof = json.loads(bytes(res.proof).decode("utf-8"))
     from tendermint_tpu.rpc.core import jsonify
     proof = []
     for hh in range(since_height + 1, h + 1):
@@ -92,7 +114,7 @@ def serve_read(node, key: bytes, since_height: int = 0,
         # (FullCommit.from_obj parses the hex form either way)
         proof.append(jsonify(fc.to_obj()))
     meta = store.load_block_meta(h)
-    return {
+    out = {
         "chain_id": node.gen_doc.chain_id,
         "key": bytes(key).hex(),
         "value": value.hex(),
@@ -100,6 +122,10 @@ def serve_read(node, key: bytes, since_height: int = 0,
         "app_hash": (meta.header.app_hash.hex() if meta else ""),
         "proof_commits": proof,
     }
+    if value_proof is not None:
+        out["value_height"] = value_height
+        out["value_proof"] = value_proof
+    return out
 
 
 class CertifiedReader:
@@ -176,6 +202,13 @@ class CertifiedReader:
             self._certifiers[chain_id] = cert
         doc = self._shard_read(key, cert.certified_height)
         try:
+            doc_key = doc.get("key", "")
+            doc_key = bytes.fromhex(doc_key) \
+                if isinstance(doc_key, str) else bytes(doc_key)
+            if doc_key != key:
+                raise ReadProofError(
+                    f"response is for key {doc_key.hex()}, asked for "
+                    f"{key.hex()}")
             self.verify(doc, cert)
         except ReadProofError:
             _m_cross_reads.labels("rejected").inc()
@@ -192,6 +225,8 @@ class CertifiedReader:
             "certified_height": cert.certified_height,
             "valset_updates": cert.updates,
             "mapping_version": doc.get("mapping_version"),
+            "value_height": doc.get("value_height"),
+            "proven": doc.get("value_proof") is not None,
         }
 
     @staticmethod
@@ -220,6 +255,35 @@ class CertifiedReader:
             raise ReadProofError(
                 f"proof chain stops at {cert.certified_height}, "
                 f"value was read at height {doc.get('height')}")
+        if doc.get("value_proof") is None:
+            return  # head-only certification (bucket-mode chain)
+        # value -> root -> app_hash -> commit: the state proof must
+        # verify against the CERTIFIED app hash of the header at
+        # value_height + 1 (which binds the state after value_height),
+        # never against anything server-claimed.
+        from tendermint_tpu import statetree
+        try:
+            value_height = int(doc.get("value_height", -1))
+        except (TypeError, ValueError):
+            raise ReadProofError("malformed value_height")
+        anchor = cert.app_hashes.get(value_height + 1)
+        if anchor is None:
+            raise ReadProofError(
+                f"no certified header at height {value_height + 1} "
+                f"anchors the value proof (certified: "
+                f"{sorted(cert.app_hashes)})")
+        value = doc.get("value", b"")
+        if isinstance(value, str):
+            value = bytes.fromhex(value)
+        key = doc.get("key", "")
+        key = bytes.fromhex(key) if isinstance(key, str) else bytes(key)
+        try:
+            pf = statetree.proof_from_obj(doc["value_proof"])
+            statetree.verify(
+                pf, key, value if pf.present else (value or None),
+                anchor)
+        except statetree.ProofError as e:
+            raise ReadProofError(f"value proof rejected: {e}") from e
 
 
 def _genesis_valset(gen_doc):

@@ -116,7 +116,7 @@ _PASSTHROUGH = (
     "status", "net_info", "blockchain", "genesis", "block",
     "block_results", "commit", "validators", "dump_consensus_state",
     "unconfirmed_txs", "num_unconfirmed_txs", "abci_info", "tx",
-    "tx_search", "dump_height_timeline",
+    "dump_height_timeline",
 )
 
 
@@ -221,6 +221,42 @@ class ShardRouter:
             core = self.core_for_key(data)
         return core.abci_query(path, data, height=height, prove=prove)
 
+    def tx_search(self, query: str = "", prove: bool = False,
+                  page: int = 1, per_page: int = 30,
+                  chain_id: str = "") -> dict:
+        """Indexed reads through the front door (ISSUE 16 satellite):
+        a caller usually does not know which shard a tx landed on, so
+        without a chain_id the search FANS OUT to every shard's
+        indexer and merges (chain-tagged, height-then-index order,
+        paginated over the merged set). Shards with indexing disabled
+        are skipped; only all-disabled raises — matching the
+        single-chain error surface."""
+        from tendermint_tpu.rpc.core import RPCError
+        from tendermint_tpu.state.txindex import NullTxIndexer
+        cores = self._cores_for(chain_id)
+        merged: list = []
+        enabled = 0
+        for core, chain in zip(cores, (
+                [chain_id] if chain_id else self.map.chains)):
+            if core.env.tx_indexer is None or \
+                    isinstance(core.env.tx_indexer, NullTxIndexer):
+                continue
+            enabled += 1
+            for r in core.env.tx_indexer.search(query):
+                merged.append({**r, "chain_id": chain})
+        if not enabled:
+            raise RPCError(-32000, "transaction indexing is disabled "
+                           "on every shard")
+        merged.sort(key=lambda r: (r.get("height", 0),
+                                   r.get("index", 0),
+                                   r.get("chain_id", "")))
+        total = len(merged)
+        start = max(0, (int(page) - 1) * int(per_page))
+        from tendermint_tpu.rpc.core import jsonify
+        return jsonify({"txs": merged[start:start + int(per_page)],
+                        "total_count": total,
+                        "mapping_version": self.map.version})
+
     def shard_read(self, key: bytes, since_height: int = 0) -> dict:
         """Certified cross-shard read (shard/reads.py): the value from
         the owning shard plus the FullCommit chain a client-side
@@ -294,6 +330,7 @@ class ShardRouter:
             "broadcast_tx_commit": self.broadcast_tx_commit,
             "broadcast_tx_batch": self.broadcast_tx_batch,
             "abci_query": self.abci_query,
+            "tx_search": self.tx_search,
             "shard_read": self.shard_read,
             "shards": self.shards,
             "healthz": self.healthz,

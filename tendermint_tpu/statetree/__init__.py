@@ -1,0 +1,26 @@
+"""Authenticated state tree (ISSUE 16) — the KVStore's proof-carrying
+commit backend behind TM_TPU_STATE_TREE.
+
+A persistent critbit Merkle trie over sha256(key) bits: per-key update
+is an O(log n) copy-on-write path, commit rehashes only the dirty
+subtree (batched through ops/merkle), app_hash = tree root, and every
+key gets a compact inclusion OR absence proof a client verifies
+against a lite-certified header's app_hash — closing the PR 15
+cross-shard trust gap (value -> root -> app_hash -> commit). See
+docs/state.md for the structure, determinism argument, and proof
+format walkthrough.
+"""
+
+from tendermint_tpu.statetree.codec import (  # noqa: F401
+    proof_from_bytes,
+    proof_from_obj,
+    proof_to_bytes,
+    proof_to_obj,
+)
+from tendermint_tpu.statetree.proof import (  # noqa: F401
+    ProofError,
+    StateProof,
+    verify,
+)
+from tendermint_tpu.statetree.store import NodeStore  # noqa: F401
+from tendermint_tpu.statetree.tree import StateTree  # noqa: F401

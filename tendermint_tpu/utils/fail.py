@@ -49,6 +49,12 @@ COMMIT_POINTS = (
     "consensus.before_save_block",
     "execution.after_exec_block",
     "execution.after_save_abci_responses",
+    # the two statetree points live INSIDE the app Commit call (between
+    # after_save_abci_responses and after_app_commit) and only fire
+    # when TM_TPU_STATE_TREE is on — the catalog-order tests pin them
+    # with the knob set; bucket-mode sweeps simply never count them
+    "statetree.before_root_flush",
+    "statetree.after_node_write",
     "execution.after_app_commit",
     "execution.after_save_state",
     "consensus.before_group_flush",
@@ -82,6 +88,8 @@ SERIAL_COMMIT_POINTS = (
     "consensus.after_wal_end_height",
     "execution.after_exec_block",
     "execution.after_save_abci_responses",
+    "statetree.before_root_flush",
+    "statetree.after_node_write",
     "execution.after_app_commit",
     "execution.after_save_state",
     "consensus.after_apply_block",

@@ -208,6 +208,14 @@ CATALOG: tuple[Knob, ...] = (
          "A fresh node joins via p2p snapshot restore (statesync/) and "
          "fast-syncs only the tail; off = full block replay.",
          "statesync/reactor.py"),
+    Knob("TM_TPU_STATE_TREE", "bool", "off", "",
+         "KVStore commit backend: on = authenticated state tree "
+         "(statetree/, docs/state.md) — app_hash is a critbit Merkle "
+         "root, per-key inclusion/absence proofs bind values to "
+         "certified headers; off = bucketed accumulator (no proofs). "
+         "Chain-level: every validator must agree, the two backends "
+         "hash differently by design.",
+         "abci/apps/kvstore.py"),
     # -- shard plane -------------------------------------------------------
     Knob("TM_TPU_SHARDS", "int", "0 (off)", "base.shards",
          "Default chain count a ShardSet assembles: N independent "

@@ -78,11 +78,14 @@ def _drain(node, max_ticks=200):
 
 # ---------------------------------------------------------- catalog --
 
-def test_commit_points_fire_in_catalog_order(tmp_path):
+def test_commit_points_fire_in_catalog_order(tmp_path, monkeypatch):
     """One commit passes every COMMIT_POINTS entry, in order — the
     catalog is what schedules and docs reference, so it must match the
     code path exactly. COMMIT_POINTS documents the default (pipelined)
-    order; the serial escape hatch is pinned separately below."""
+    order; the serial escape hatch is pinned separately below. The
+    statetree points only fire with the tree backend on, so the
+    catalog-order pin runs with TM_TPU_STATE_TREE set."""
+    monkeypatch.setenv("TM_TPU_STATE_TREE", "on")
     seen = []
     for name in fail.COMMIT_POINTS:
         fail.arm(name, seen.append)
@@ -101,6 +104,7 @@ def test_commit_points_serial_order_with_pipeline_off(tmp_path,
     commits immediately, ENDHEIGHT fsyncs BEFORE ApplyBlock, and the
     group-flush brackets never fire (SERIAL_COMMIT_POINTS order)."""
     monkeypatch.setenv("TM_TPU_PIPELINE", "off")
+    monkeypatch.setenv("TM_TPU_STATE_TREE", "on")
     seen = []
     for name in fail.COMMIT_POINTS:
         fail.arm(name, seen.append)

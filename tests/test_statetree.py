@@ -1,0 +1,390 @@
+"""Authenticated state tree (ISSUE 16): structure determinism,
+incremental commits vs full rebuilds, copy-on-write version retention,
+inclusion/absence proofs + the forged-proof matrix, wire codec
+validation, the KVStore tree backend (A/B app-hash divergence pinned),
+snapshot streaming, and the crash-at-every-statetree-fail-point
+recovery sweep (pattern from tests/test_snapshot.py)."""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+from tendermint_tpu import statetree
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.consensus import MockTicker
+from tendermint_tpu.node import Node
+from tendermint_tpu.ops import merkle
+from tendermint_tpu.statetree import ProofError, StateTree
+from tendermint_tpu.statetree.tree import _bit, _first_diff_bit
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivKey
+from tendermint_tpu.types.priv_validator import PrivValidatorFile
+from tendermint_tpu.utils import fail
+
+
+def _fill(tree, pairs):
+    for k, v in pairs:
+        tree.set(k, v)
+
+
+def _pairs(n, tag=b"v"):
+    return [(b"key/%d" % i, tag + b"%d" % i) for i in range(n)]
+
+
+# ------------------------------------------------------------ structure --
+
+def test_root_is_insertion_order_independent():
+    pairs = _pairs(400)
+    t1 = StateTree()
+    _fill(t1, pairs)
+    shuffled = pairs[:]
+    random.Random(13).shuffle(shuffled)
+    t2 = StateTree()
+    _fill(t2, shuffled)
+    assert t1.commit(1) == t2.commit(1)
+
+
+def test_incremental_equals_rebuild_under_churn():
+    """Random set/update/delete churn across several commits lands on
+    exactly the root a fresh tree over the surviving state computes —
+    the incremental dirty-subtree rehash hides nothing."""
+    rng = random.Random(29)
+    tree = StateTree()
+    model = {}
+    for version in range(1, 6):
+        for _ in range(300):
+            op = rng.random()
+            k = b"churn/%d" % rng.randrange(500)
+            if op < 0.6 or k not in model:
+                v = b"val-%d" % rng.randrange(10 ** 6)
+                tree.set(k, v)
+                model[k] = v
+            else:
+                assert tree.delete(k)
+                del model[k]
+        root = tree.commit(version)
+        rebuilt = StateTree()
+        _fill(rebuilt, sorted(model.items()))
+        assert rebuilt.commit(1) == root
+        assert len(tree) == len(model)
+    assert dict(tree.items_at(5)) == model
+
+
+def test_bit_helpers():
+    kh = bytes([0b10110000] + [0] * 31)
+    assert [_bit(kh, i) for i in range(4)] == [1, 0, 1, 1]
+    other = bytes([0b10100000] + [0] * 31)
+    assert _first_diff_bit(kh, other) == 3
+    with pytest.raises(ValueError):
+        _first_diff_bit(kh, kh)
+
+
+def test_copy_on_write_versions_stay_provable():
+    tree = StateTree(retain=3)
+    _fill(tree, _pairs(50))
+    r1 = tree.commit(1)
+    tree.set(b"key/7", b"seven")
+    tree.delete(b"key/9")
+    r2 = tree.commit(2)
+    # version 1 unchanged under the mutation: old value still proves
+    v, p = tree.prove(b"key/7", 1)
+    assert v == b"v7"
+    statetree.verify(p, b"key/7", v, r1)
+    v, p = tree.prove(b"key/9", 1)
+    statetree.verify(p, b"key/9", v, r1)
+    # version 2 sees the new world
+    v, p = tree.prove(b"key/7", 2)
+    assert v == b"seven"
+    statetree.verify(p, b"key/7", v, r2)
+    v, p = tree.prove(b"key/9", 2)
+    assert v is None and not p.present
+    statetree.verify(p, b"key/9", None, r2)
+    # retention: the registry keeps the newest `retain` versions
+    tree.commit(3)
+    tree.commit(4)
+    with pytest.raises(KeyError):
+        tree.prove(b"key/7", 1)
+    assert tree.store.versions() == [2, 3, 4]
+
+
+def test_empty_and_single_key_trees():
+    tree = StateTree()
+    r0 = tree.commit(1)
+    v, p = tree.prove(b"ghost", 1)
+    assert v is None and p.n_keys == 0
+    statetree.verify(p, b"ghost", None, r0)
+    tree.set(b"only", b"one")
+    r1 = tree.commit(2)
+    assert r1 != r0
+    v, p = tree.prove(b"only", 2)
+    assert v == b"one" and p.steps == []
+    statetree.verify(p, b"only", v, r1)
+    v, p = tree.prove(b"ghost", 2)
+    assert not p.present and p.other_key_hash == \
+        hashlib.sha256(b"only").digest()
+    statetree.verify(p, b"ghost", None, r1)
+    # deleting the last key returns to the (size-bound) empty root
+    assert tree.delete(b"only")
+    assert tree.commit(3) == r0
+
+
+# --------------------------------------------------------------- proofs --
+
+def test_forged_proofs_raise():
+    """The forgery matrix: every tampering of a valid proof must raise
+    ProofError — never verify, never return a soft False."""
+    tree = StateTree()
+    _fill(tree, _pairs(200))
+    root = tree.commit(1)
+    value, good = tree.prove(b"key/55", 1)
+    statetree.verify(good, b"key/55", value, root)
+
+    import copy
+
+    def variant(mutate):
+        p = copy.deepcopy(good)
+        mutate(p)
+        return p
+
+    forgeries = {
+        "tampered value": (good, b"evil-value"),
+        "truncated path": (variant(
+            lambda p: setattr(p, "steps", p.steps[:-1])), value),
+        "extended path": (variant(
+            lambda p: p.steps.append((255, b"\x11" * 32))), value),
+        "sibling swap": (variant(
+            lambda p: p.steps.__setitem__(
+                0, (p.steps[0][0], b"\x22" * 32))), value),
+        "step reorder": (variant(
+            lambda p: setattr(p, "steps", list(reversed(p.steps)))),
+            value),
+        "wrong n_keys (root binding)": (variant(
+            lambda p: setattr(p, "n_keys", p.n_keys + 1)), value),
+        "absence claim for present key": (variant(
+            lambda p: (setattr(p, "present", False),
+                       setattr(p, "other_key_hash", b"\x01" * 32),
+                       setattr(p, "other_value_hash", b"\x02" * 32))),
+            None),
+    }
+    for name, (proof, val) in forgeries.items():
+        with pytest.raises(ProofError):
+            statetree.verify(proof, b"key/55", val, root)
+            pytest.fail(f"forgery accepted: {name}")
+    # wrong key entirely
+    with pytest.raises(ProofError):
+        statetree.verify(good, b"key/56", value, root)
+    # wrong root
+    with pytest.raises(ProofError):
+        statetree.verify(good, b"key/55", value, b"\x00" * 32)
+    # absence proof whose divergent leaf IS the key's own leaf
+    _, absent = tree.prove(b"not-there", 1)
+    bad = copy.deepcopy(absent)
+    bad.other_key_hash = hashlib.sha256(b"not-there").digest()
+    with pytest.raises(ProofError):
+        statetree.verify(bad, b"not-there", None, root)
+
+
+def test_codec_round_trip_and_malformed_rejection():
+    tree = StateTree()
+    _fill(tree, _pairs(30))
+    root = tree.commit(1)
+    for key in (b"key/3", b"nope"):
+        value, proof = tree.prove(key, 1)
+        raw = statetree.proof_to_bytes(proof)
+        decoded = statetree.proof_from_bytes(raw)
+        statetree.verify(decoded, key, value, root)
+        assert statetree.proof_to_bytes(decoded) == raw
+    for blob in (b"", b"not json", b"[]", b'{"n_keys": -1}',
+                 b'{"n_keys": 1, "key_hash": "zz"}',
+                 b'{"n_keys": 1, "key_hash": "ab", "steps": 3}',
+                 b'{"n_keys": 1, "key_hash": "' + b"ab" * 32 +
+                 b'", "steps": [[256, "' + b"ab" * 32 + b'"]]}'):
+        with pytest.raises(ProofError):
+            statetree.proof_from_bytes(blob)
+
+
+def test_sha256_many_host_matches_hashlib():
+    payloads = [os.urandom(67) for _ in range(600)] + [b"", b"x"]
+    want = [hashlib.sha256(p).digest() for p in payloads]
+    assert merkle.sha256_many_host(payloads) == want
+    assert merkle.sha256_many_host([]) == []
+
+
+# -------------------------------------------------------- app  backend --
+
+def test_kvstore_tree_backend_proves_and_ab_hashes_diverge(monkeypatch):
+    monkeypatch.setenv("TM_TPU_STATE_TREE", "on")
+    app = KVStoreApp()
+    for i in range(40):
+        app.deliver_tx(b"ab/%d=w%d" % (i, i))
+    r1 = app.commit()
+    app.deliver_tx(b"ab/7=updated")
+    r2 = app.commit()
+    res = app.query("", b"ab/7", 0, True)
+    assert res.value == b"updated" and res.height == 2
+    statetree.verify(statetree.proof_from_bytes(res.proof),
+                     b"ab/7", res.value, r2)
+    # the PREVIOUS version still proves (the header-binding seam)
+    res = app.query("", b"ab/7", 1, True)
+    assert res.value == b"w7"
+    statetree.verify(statetree.proof_from_bytes(res.proof),
+                     b"ab/7", res.value, r1)
+    # absence, proven
+    res = app.query("", b"ab/404", 0, True)
+    pf = statetree.proof_from_bytes(res.proof)
+    assert not pf.present and res.value == b""
+    statetree.verify(pf, b"ab/404", None, r2)
+    # unproven query shape is untouched
+    res = app.query("", b"ab/7", 0, False)
+    assert res.value == b"updated" and res.proof == b""
+    # an unretained version is a soft error, not a crash
+    for _ in range(12):
+        app.commit()
+    assert app.query("", b"ab/7", 1, True).code == 1
+
+    # A/B: the bucket backend over the SAME txs hashes differently —
+    # expected and pinned, never silently reconciled
+    monkeypatch.delenv("TM_TPU_STATE_TREE")
+    bucket = KVStoreApp()
+    for i in range(40):
+        bucket.deliver_tx(b"ab/%d=w%d" % (i, i))
+    assert bucket.commit() != r1
+    assert bucket.query("", b"ab/7", 0, True).proof == b""
+
+
+def test_kvstore_tree_snapshot_streams_and_restores(monkeypatch):
+    monkeypatch.setenv("TM_TPU_STATE_TREE", "on")
+    app = KVStoreApp()
+    for i in range(60):
+        app.deliver_tx(b"sn/%d=p%d" % (i, i))
+    r1 = app.commit()
+    items = app.snapshot_items()
+    # streamed, not materialized: a generator over tree nodes
+    assert not isinstance(items, (list, tuple))
+    consumed = []
+    it = iter(items)
+    for _ in range(10):
+        consumed.append(next(it))
+    # copy-on-write keeps the in-flight stream consistent across a
+    # later commit that mutates half the state
+    for i in range(0, 60, 2):
+        app.deliver_tx(b"sn/%d=MUT" % i)
+    app.commit()
+    consumed.extend(it)
+    assert dict(consumed) == {b"sn/%d" % i: b"p%d" % i
+                              for i in range(60)}
+    # restore replays into a fresh tree and must land on r1 exactly
+    app2 = KVStoreApp()
+    assert app2.restore_items(consumed, 1, None) == r1
+    assert app2.height == 1
+    v, p = app2._tree.prove(b"sn/5", 1)
+    statetree.verify(p, b"sn/5", v, r1)
+
+
+# ------------------------------------------------- crash-recovery sweep --
+
+class _Crash(BaseException):
+    """Simulated process death at a fail point (BaseException: nothing
+    between the fail point and the test may swallow it)."""
+
+
+def _gen(chain_id):
+    key = PrivKey.generate(b"\x0e" * 32)
+    gen = GenesisDoc(chain_id=chain_id, genesis_time_ns=1,
+                     validators=[GenesisValidator(key.pubkey.ed25519,
+                                                  10)])
+    return gen, key
+
+
+def _make_node(home, gen, key):
+    pv_path = os.path.join(home, "priv_validator.json")
+    if os.path.exists(pv_path):
+        pv = PrivValidatorFile.load(pv_path)
+    else:
+        pv = PrivValidatorFile(pv_path, key)
+        pv._persist()
+    node = Node(make_test_config(home), gen, priv_validator=pv,
+                app=KVStoreApp())
+    node.consensus.ticker.stop()
+    node.consensus.ticker = MockTicker(node.consensus._on_timeout_fire)
+    return node
+
+
+def _inject(node, txs):
+    for tx in txs:
+        try:
+            node.mempool.check_tx(tx)
+        except Exception:
+            pass
+
+
+def _commit_to(node, target_height, max_ticks=400):
+    for _ in range(max_ticks):
+        if node.height >= target_height:
+            return
+        node.consensus.ticker.fire_next()
+    raise AssertionError(f"stuck at height {node.height}")
+
+
+WAVE_A = [b"st/a%d=v%d" % (i, i) for i in range(1, 4)]
+WAVE_B = [b"st/b%d=w%d" % (i, i) for i in range(1, 4)]
+
+STATETREE_POINTS = ("statetree.before_root_flush",
+                    "statetree.after_node_write")
+
+
+def test_crash_at_statetree_points_recovers_control_root(tmp_path,
+                                                         monkeypatch):
+    """Kill a tree-backed node at each statetree fail point mid-commit;
+    WAL catchup + handshake replay must rebuild the SAME tree root as
+    an uncrashed control — and the recovered tree must still prove."""
+    monkeypatch.setenv("TM_TPU_STATE_TREE", "on")
+    target = 3
+    gen, key = _gen("st-sweep")
+
+    control = _make_node(str(tmp_path / "control"), gen, key)
+    control.start()
+    _inject(control, WAVE_A)
+    _commit_to(control, 1)
+    _inject(control, WAVE_B)
+    _commit_to(control, target)
+    control_hash = control.consensus.state.app_hash
+    control.stop()
+    assert control_hash
+
+    for point in STATETREE_POINTS:
+        home = str(tmp_path / point.replace(".", "_"))
+        node = _make_node(home, gen, key)
+        node.start()
+        _inject(node, WAVE_A)
+        _commit_to(node, 1)
+
+        def crash(name):
+            raise _Crash(name)
+
+        fail.arm(point, crash)
+        with pytest.raises(_Crash):
+            _inject(node, WAVE_B)
+            _commit_to(node, target)
+        fail.disarm_all()
+        crashed_at = node.height
+        node.consensus._stopped = True
+        try:
+            node.stop()
+        except Exception:
+            pass
+
+        node2 = _make_node(home, gen, key)   # handshake replay here
+        node2.start()                        # WAL catchup replay here
+        assert node2.height >= crashed_at
+        _inject(node2, WAVE_B)
+        _commit_to(node2, target)
+        assert node2.consensus.state.app_hash == control_hash, (
+            f"{point}: recovered tree root diverged")
+        # the replayed tree still serves verifiable proofs
+        res = node2.app.query("", b"st/a1", 0, True)
+        statetree.verify(statetree.proof_from_bytes(res.proof),
+                         b"st/a1", res.value, node2.app.app_hash)
+        node2.stop()
