@@ -805,7 +805,7 @@ def bench_slo_json(path: str = "BENCH_slo.json",
             r = bench_testnet.run_socket(
                 duration_s=duration_s, reactor="loop", slo=mode,
                 slo_sample=sample if mode == "on" else 0.0,
-                tx_subscribers=1)
+                tx_subscribers=1, parity=True)
             rounds[mode].append(r["blocks_per_sec"])
             # best-of-N per arm (the PR 12 A/B discipline on this
             # ±25%-drift host); the SLO table rides the best on-arm
@@ -813,6 +813,21 @@ def bench_slo_json(path: str = "BENCH_slo.json",
                     arms[mode]["blocks_per_sec"]:
                 arms[mode] = r
     off, on = arms["off"], arms["on"]
+
+    # PR 18 compact-plane A/B: the identical workload with the compact
+    # gossip plane forced OFF (legacy full-part relay + one-vote-per-
+    # message gossip). Every arm above ran compact/voteagg at their
+    # auto default (on), so this is the control. Chain parity (the
+    # serial replay audit) must hold on BOTH arms — the compact plane
+    # changes how bytes MOVE, never which bytes COMMIT.
+    print("[bench] compact arm TM_TPU_COMPACT=off "
+          "TM_TPU_VOTE_AGG=off (control)...",
+          file=sys.stderr, flush=True)
+    compact_off = bench_testnet.run_socket(
+        duration_s=duration_s, reactor="loop", slo="off",
+        tx_subscribers=1, parity=True,
+        child_env={"TM_TPU_COMPACT": "off", "TM_TPU_VOTE_AGG": "off"})
+
     reports = on.pop("slo_reports", [])
     merged = slo_mod.merge_snapshots(reports)
 
@@ -833,8 +848,22 @@ def bench_slo_json(path: str = "BENCH_slo.json",
         attribution.get("dominant_stage"), \
         "acceptance: tail attribution must name the dominant p99 stage"
 
+    cm = on.get("compact_metrics", {})
+    assert cm.get("voteagg_mean_batch", 0) > 1, (
+        "acceptance: vote aggregation must batch >1 vote per message, "
+        f"got {cm.get('voteagg_mean_batch')}")
+    for arm_name, arm in (("compact_on", on), ("compact_off",
+                                               compact_off)):
+        assert arm.get("parity", {}).get(
+            "app_hash_chain_bit_identical"), (
+            f"acceptance: chain parity audit missing/failed on the "
+            f"{arm_name} arm")
+
     ratio = round(on["blocks_per_sec"] / off["blocks_per_sec"], 3) \
         if off.get("blocks_per_sec") else None
+    compact_ratio = round(
+        on["blocks_per_sec"] / compact_off["blocks_per_sec"], 3) \
+        if compact_off.get("blocks_per_sec") else None
     doc = {
         "metric": "slo_tx_lifecycle_latency",
         "unit": "ms (per-stage quantiles)",
@@ -850,6 +879,10 @@ def bench_slo_json(path: str = "BENCH_slo.json",
         "knobs": {"TM_TPU_SLO": "off/on per arm",
                   "TM_TPU_SLO_SAMPLE": sample,
                   "TM_TPU_REACTOR": "loop both arms",
+                  "TM_TPU_COMPACT": "auto (on) both SLO arms; "
+                                    "off in the control arm",
+                  "TM_TPU_VOTE_AGG": "auto (on) both SLO arms; "
+                                     "off in the control arm",
                   "duration_s_per_arm": duration_s,
                   "trials_per_arm": trials},
         "trial_blocks_per_sec": rounds,
@@ -878,6 +911,27 @@ def bench_slo_json(path: str = "BENCH_slo.json",
                     "1-core container (cross-session drift ±25%, "
                     "see BENCH_profile.json) — the off hot path is "
                     "one cached flag check per entry point",
+        },
+        # the PR 18 compact gossip plane: reconstruct economics from
+        # the on-arm's cluster-summed /metrics, plus the forced-off
+        # control and the parity audits proving both wires commit the
+        # bit-identical chain
+        "compact": {
+            "compact_reconstruct_hit_rate":
+                cm.get("compact_reconstruct_hit_rate"),
+            "voteagg_mean_batch": cm.get("voteagg_mean_batch"),
+            "metrics": cm,
+            "ab": {
+                "compact_on_blocks_per_sec": on["blocks_per_sec"],
+                "compact_off_blocks_per_sec":
+                    compact_off["blocks_per_sec"],
+                "on_over_off_ratio": compact_ratio,
+                "compact_on_txs_per_sec": on["txs_per_sec"],
+                "compact_off_txs_per_sec":
+                    compact_off["txs_per_sec"],
+            },
+            "parity": {"compact_on": on.get("parity"),
+                       "compact_off": compact_off.get("parity")},
         },
     }
     with open(path, "w") as f:

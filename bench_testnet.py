@@ -265,6 +265,43 @@ def _scrape_pipeline_metrics(client) -> dict:
     return out
 
 
+def _scrape_compact_metrics(clients) -> dict:
+    """tm_compact_* / tm_voteagg_* summed across EVERY node — one
+    node's sends are another's reconstructions, so per-node numbers
+    understate the plane. Adds the two derived ratios the trend gate
+    tracks: reconstruct hit rate (hit+fetched over all attempts) and
+    mean votes per aggregate."""
+    import re
+    out: dict = {}
+    for c in clients:
+        text = c.call("metrics")["exposition"]
+        for line in text.splitlines():
+            m = re.match(r'^(tm_(?:compact|voteagg)_[a-z_]+?)'
+                         r'(\{[^}]*\})? ([0-9.e+-]+)$', line)
+            if not m:
+                continue
+            key = m.group(1) + (m.group(2) or "")
+            out[key] = out.get(key, 0.0) + float(m.group(3))
+    if not out:
+        return {}
+    out = {k: (int(v) if float(v).is_integer() else v)
+           for k, v in out.items()}
+    hit = out.get('tm_compact_reconstruct_total{outcome="hit"}', 0)
+    fetched = out.get(
+        'tm_compact_reconstruct_total{outcome="fetched"}', 0)
+    fallback = out.get(
+        'tm_compact_reconstruct_total{outcome="fallback"}', 0)
+    attempts = hit + fetched + fallback
+    if attempts:
+        out["compact_reconstruct_hit_rate"] = round(
+            (hit + fetched) / attempts, 4)
+    batch_sum = out.get("tm_voteagg_batch_votes_sum", 0)
+    batch_n = out.get("tm_voteagg_batch_votes_count", 0)
+    if batch_n:
+        out["voteagg_mean_batch"] = round(batch_sum / batch_n, 2)
+    return out
+
+
 def _chain_parity(clients, part_size: int = 65536) -> dict:
     """Bit-identity audit of a finished arm's chain, recomputed SERIALLY
     in this (parent) process:
@@ -696,6 +733,10 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             pipeline_metrics = _scrape_pipeline_metrics(clients[0])
         except Exception:
             pipeline_metrics = {}
+        try:
+            compact_metrics = _scrape_compact_metrics(clients)
+        except Exception:
+            compact_metrics = {}
         timelines = []
         if trace:
             # every node's span ring BEFORE teardown: the measured
@@ -767,6 +808,8 @@ def run_socket(n_vals: int = 4, n_txs_target: int = 1000,
             "p2p": p2p_metrics,
             **({"pipeline_metrics": pipeline_metrics}
                if pipeline_metrics else {}),
+            **({"compact_metrics": compact_metrics}
+               if compact_metrics else {}),
             **({"parity": parity_report} if parity_report else {}),
             **({"chaos": chaos, "chaos_faults": chaos_metrics}
                if chaos_metrics else {}),

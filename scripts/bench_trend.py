@@ -112,6 +112,15 @@ EXTRACTORS = (
      "stages.e2e_commit.p99_ms", "ms", "down"),
     ("slo_delivery_p99_ms", "BENCH_slo.json",
      "stages.e2e_delivery.p99_ms", "ms", "down"),
+    # the ISSUE-18 compact gossip plane: how often a compact block
+    # offer resolves from the receiver's own mempool (hit, or a
+    # bounded fetch of the few missing txs) instead of falling back to
+    # full part relay, and the mean votes carried per aggregate gossip
+    # message — regressions mean the consensus wire got chattier
+    ("compact_reconstruct_hit_rate", "BENCH_slo.json",
+     "compact.compact_reconstruct_hit_rate", "fraction", "up"),
+    ("voteagg_mean_batch", "BENCH_slo.json",
+     "compact.voteagg_mean_batch", "votes/msg", "up"),
     # the ISSUE-15 shard plane: aggregate commit rate and the coalesce
     # factor at 8 chains in one process — the paper's amortization
     # claim (concurrent sub-threshold verifies from many chains merge

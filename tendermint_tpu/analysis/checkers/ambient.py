@@ -99,6 +99,8 @@ BLESSED = frozenset((
     "tendermint_tpu/p2p/conn/burst.py:_cfg_mode",
     "tendermint_tpu/p2p/conn/burst.py:_cfg_max",
     "tendermint_tpu/pipeline.py:_configured",
+    "tendermint_tpu/consensus/compact.py:_configured_compact",
+    "tendermint_tpu/consensus/compact.py:_configured_voteagg",
     # misc process plumbing
     "tendermint_tpu/p2p/switch.py:_protocol_error_types",
     "tendermint_tpu/rpc/core.py:_m_tx_batched",

@@ -31,7 +31,7 @@ KNOWN_SUBSYSTEMS = {
     "rpc", "node", "storage", "evidence", "lite", "telemetry", "event",
     "chaos", "mesh", "pipeline", "partset", "trace",
     "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
-    "slo", "shard", "statetree",
+    "slo", "shard", "statetree", "compact", "voteagg",
 }
 
 INSTRUMENTED_MODULES = [
@@ -61,6 +61,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.telemetry.slo",      # tm_slo_* tx-lifecycle plane
     "tendermint_tpu.shard.router",       # tm_shard_* router/height plane
     "tendermint_tpu.statetree.store",    # tm_statetree_* commit/proof plane
+    "tendermint_tpu.consensus.compact",  # tm_compact_*/tm_voteagg_* gossip
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

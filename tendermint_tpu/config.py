@@ -54,6 +54,15 @@ class BaseConfig:
     # persistence. auto|on|off; TM_TPU_PIPELINE wins over this. "off"
     # restores the serial per-height code byte-for-byte.
     pipeline: str = "auto"
+    # compact consensus gossip (consensus/compact.py): `compact` relays
+    # proposals as header + salted short tx ids (receivers rebuild the
+    # block from their mempool, fetch only missing txs, and fall back
+    # to full part gossip on miss/timeout); `vote_agg` batches the
+    # votes a peer lacks into one message verified as one coalesced
+    # dispatch. auto|on|off each; TM_TPU_COMPACT / TM_TPU_VOTE_AGG win.
+    # Both off = today's wire bytes byte-for-byte.
+    compact: str = "auto"
+    vote_agg: str = "auto"
     # causal tracing plane (telemetry/causal.py): per-height consensus
     # spans, trace-stamped p2p envelopes, the dump_height_timeline RPC
     # and the stall-detector flight recorder. off (the default) keeps

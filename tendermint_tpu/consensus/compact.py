@@ -1,0 +1,270 @@
+"""Compact consensus gossip — compact block relay + aggregated votes.
+
+ISSUE 18 / ROADMAP items 1+4: the committed trace plane attributes
+~78% of height wall to part delivery + quorum assembly. Both are
+structural costs of the reference wire shape, not of the machinery:
+
+- every proposal byte re-ships through the part-set plane even though
+  the receivers already hold the txs in their mempools, and
+- votes arrive as n scalar messages, so the verifier sees batch size 1
+  on the consensus hot path no matter how well it coalesces.
+
+This module is the shared plumbing for the two compact-plane knobs:
+
+- `TM_TPU_COMPACT` (env > config.base.compact > default auto = on):
+  `_gossip_data_pass` sends a compact proposal — header + ordered
+  salted short tx ids — instead of streaming parts; receivers rebuild
+  the block from their mempool by hash (mempool.get_by_hash), fetch
+  only the missing txs, and re-split it onto the canonical PartSet
+  (types/part_set.py `from_data`, native `partset_build` when the
+  pipeline knob allows) so block_id, WAL shape and chain parity are
+  untouched. Reconstruction failure or timeout falls back to full
+  part gossip automatically — compact is an optimization, never a
+  liveness dependency.
+- `TM_TPU_VOTE_AGG` (env > config.base.vote_agg > default auto = on):
+  the vote gossip pass batches every vote a peer provably lacks for
+  one (height, round, type) into a single `vote_agg` message, and the
+  receiving state machine feeds the whole group through
+  `HeightVoteSet.add_votes` -> `VoteSet.add_votes_batch` — ONE
+  verifier dispatch per aggregate instead of one per vote.
+
+Both knobs off = today's wire bytes byte-for-byte (test-asserted):
+no capability strings in the handshake, no new message types sent,
+and unknown types are ignored by legacy receivers either way — which
+is also what makes a mixed compact/legacy net converge. Senders gate
+the new shapes on the peer's advertised capability (NodeInfo.other),
+so a compact node never sends a message a legacy peer would drop.
+
+Misbehaving peers (a fetch that never returns, a compact body that
+does not match the proposal's part-set header) earn strikes with the
+PR 9 exponential backoff discipline (blockchain/pool.py): while a
+peer is in backoff its compact offers are refused (nack — the sender
+falls back to parts) and our own compact sends to it are skipped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional
+
+from tendermint_tpu import telemetry
+from tendermint_tpu.utils import knobs
+
+#: capability strings advertised in NodeInfo.other — version-suffixed
+#: so an incompatible future wire shape can bump without ambiguity
+CAP_COMPACT = "compact/1"
+CAP_VOTEAGG = "voteagg/1"
+
+#: bytes per salted short tx id on the wire (BIP-152 uses 6; 8 keeps
+#: the collision probability negligible at mempool scale for free)
+SHORT_ID_LEN = 8
+
+#: upper bound on txs requested in one tx_fetch (and served in one
+#: reply) — beyond this the receiver nacks and takes the parts path;
+#: a mempool cold enough to miss this many txs won't win on bytes
+MAX_FETCH = 256
+
+#: upper bound on votes in one vote_agg message (4 validators need 4;
+#: the in-process chaos nets run hundreds)
+MAX_AGG_VOTES = 256
+
+#: seconds a compact sender keeps an offer outstanding before writing
+#: it off as unanswered, and a receiver waits for the matching
+#: proposal before nacking. The sender never stalls parts behind an
+#: offer (high-bandwidth mode: parts stream until the ack marks them
+#: known), so this bounds bookkeeping, not latency.
+COMPACT_DEADLINE_S = 0.35
+
+#: deadline extension while a tx_fetch round trip is legitimately in
+#: flight (both sides): a loaded host serving ~100 txs under the
+#: consensus lock routinely needs more than the base window, and the
+#: parts race on regardless
+FETCH_DEADLINE_S = 0.75
+
+#: nack reasons that are nobody's fault (round moved on, receiver
+#: backing off, reconstruction already in flight) — the sender ships
+#: parts but must NOT strike, or one stale offer at a round edge
+#: cascades into mutual backoff and disengages the plane
+BENIGN_NACKS = frozenset(("stale", "backoff", "busy"))
+
+# strike/backoff discipline mirrors blockchain/pool.py (PR 9)
+BACKOFF_BASE_S = 1.0
+BACKOFF_CAP_S = 30.0
+
+_m_compact_sent = telemetry.counter(
+    "compact_blocks_sent_total",
+    "Compact proposals sent to capable peers")
+_m_compact_recv = telemetry.counter(
+    "compact_blocks_received_total",
+    "Compact proposals received, by what happened next",
+    ("outcome",))  # accepted | stale | backoff | dup
+_m_reconstruct = telemetry.counter(
+    "compact_reconstruct_total",
+    "Block reconstruction attempts by outcome", ("outcome",))
+# outcome: hit (all txs from mempool) | fetched (completed after a
+# tx_fetch round trip) | fallback (nacked/timed out -> part gossip)
+_m_fetch_req = telemetry.counter(
+    "compact_fetch_requests_total",
+    "tx_fetch messages sent for missing txs")
+_m_fetch_miss_txs = telemetry.histogram(
+    "compact_fetch_missing_txs",
+    "Missing txs per reconstruction that needed a fetch",
+    buckets=telemetry.POW2_BUCKETS)
+_m_fetch_served = telemetry.counter(
+    "compact_fetch_txs_served_total",
+    "Txs served to peers from tx_fetch requests")
+_m_strikes = telemetry.counter(
+    "compact_peer_strikes_total",
+    "Strikes issued against peers on the compact plane", ("reason",))
+_m_agg_sent = telemetry.counter(
+    "voteagg_msgs_sent_total", "Aggregated vote messages sent")
+_m_agg_votes_sent = telemetry.counter(
+    "voteagg_votes_sent_total", "Votes carried inside aggregates")
+_m_agg_batch = telemetry.histogram(
+    "voteagg_batch_votes",
+    "Votes per aggregate applied through the bulk VoteSet path",
+    buckets=telemetry.POW2_BUCKETS)
+
+# config.base.{compact,vote_agg} snapshots (node.py configure()); env
+# wins inside the resolvers, so reactors built without a Node honor
+# the knobs too (pipeline.py discipline).
+_configured_compact = "auto"
+_configured_voteagg = "auto"
+
+
+def configure(compact_mode: str = "auto",
+              voteagg_mode: str = "auto") -> None:
+    global _configured_compact, _configured_voteagg
+    _configured_compact = str(compact_mode or "auto").strip().lower()
+    _configured_voteagg = str(voteagg_mode or "auto").strip().lower()
+
+
+def compact_on() -> bool:
+    """env TM_TPU_COMPACT > config.base.compact > auto (= on)."""
+    v = knobs.knob_str("TM_TPU_COMPACT", config=_configured_compact,
+                       default="auto")
+    return v not in knobs.FALSY
+
+
+def voteagg_on() -> bool:
+    """env TM_TPU_VOTE_AGG > config.base.vote_agg > auto (= on)."""
+    v = knobs.knob_str("TM_TPU_VOTE_AGG", config=_configured_voteagg,
+                       default="auto")
+    return v not in knobs.FALSY
+
+
+def wire_capabilities() -> List[str]:
+    """Capability strings for NodeInfo.other. Empty with both knobs
+    off — the handshake bytes stay exactly the legacy shape."""
+    caps = []
+    if compact_on():
+        caps.append(CAP_COMPACT)
+    if voteagg_on():
+        caps.append(CAP_VOTEAGG)
+    return caps
+
+
+def peer_capabilities(peer) -> tuple:
+    """(supports_compact, supports_voteagg) from a peer's handshaken
+    NodeInfo.other; tolerant of test doubles without node_info."""
+    other = getattr(getattr(peer, "node_info", None), "other", None) or ()
+    return (CAP_COMPACT in other, CAP_VOTEAGG in other)
+
+
+# ------------------------------------------------------------- short ids
+
+def proposal_salt(signature: bytes) -> bytes:
+    """Per-proposal short-id salt, derived from the proposal signature
+    (unpredictable before the proposer signs, identical for every
+    receiver of the same proposal)."""
+    return hashlib.sha256(b"tm/compact/1" + signature).digest()[:8]
+
+
+def short_id(salt: bytes, tx_hash: bytes) -> bytes:
+    """Salted short id of a tx, computed from its FULL sha256 hash —
+    the mempool index stores full hashes, so receivers never rehash
+    tx bodies to match."""
+    return hashlib.sha256(salt + tx_hash).digest()[:SHORT_ID_LEN]
+
+
+def short_ids_for(salt: bytes, txs: List[bytes]) -> List[bytes]:
+    sha = hashlib.sha256
+    return [sha(salt + sha(tx).digest()).digest()[:SHORT_ID_LEN]
+            for tx in txs]
+
+
+# ------------------------------------------------------- strike ledger
+
+class StrikeLedger:
+    """Per-peer strike counter with the PR 9 exponential backoff
+    (blockchain/pool.py discipline, minus the jitter — the compact
+    plane has no synchronized retry storm to break up). While a peer
+    is in backoff we neither send it compact proposals nor accept
+    compact proposals from it; parts flow as before."""
+
+    def __init__(self, base_s: float = BACKOFF_BASE_S,
+                 cap_s: float = BACKOFF_CAP_S):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self._lock = threading.Lock()
+        self._strikes: Dict[str, int] = {}
+        self._until: Dict[str, float] = {}
+
+    def strike(self, peer_id: str, now: float, reason: str) -> None:
+        with self._lock:
+            n = self._strikes.get(peer_id, 0) + 1
+            self._strikes[peer_id] = n
+            self._until[peer_id] = now + min(
+                self.cap_s, self.base_s * (2 ** (n - 1)))
+        if telemetry.enabled():
+            _m_strikes.labels(reason).inc()
+
+    def in_backoff(self, peer_id: str, now: float) -> bool:
+        with self._lock:
+            return now < self._until.get(peer_id, 0.0)
+
+    def forget(self, peer_id: str) -> None:
+        with self._lock:
+            self._strikes.pop(peer_id, None)
+            self._until.pop(peer_id, None)
+
+
+# ----------------------------------------------------------- metrics api
+
+def note_compact_sent() -> None:
+    if telemetry.enabled():
+        _m_compact_sent.inc()
+
+
+def note_compact_received(outcome: str) -> None:
+    if telemetry.enabled():
+        _m_compact_recv.labels(outcome).inc()
+
+
+def note_reconstruct(outcome: str) -> None:
+    """outcome: hit | fetched | fallback."""
+    if telemetry.enabled():
+        _m_reconstruct.labels(outcome).inc()
+
+
+def note_fetch_request(missing: int) -> None:
+    if telemetry.enabled():
+        _m_fetch_req.inc()
+        _m_fetch_miss_txs.observe(missing)
+
+
+def note_fetch_served(n: int) -> None:
+    if telemetry.enabled() and n:
+        _m_fetch_served.inc(n)
+
+
+def note_agg_sent(n_votes: int) -> None:
+    if telemetry.enabled():
+        _m_agg_sent.inc()
+        _m_agg_votes_sent.inc(n_votes)
+
+
+def note_agg_applied(n_votes: int) -> None:
+    if telemetry.enabled():
+        _m_agg_batch.observe(n_votes)

@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from tendermint_tpu.consensus import compact
 from tendermint_tpu.consensus.rstate import Step
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.telemetry import causal
@@ -69,6 +70,11 @@ class PeerRoundState:
         self.proposal_parts: set = set()      # part indices the peer has
         self.proposal_pol_round = -1
         self.last_commit_round = -1
+        # compact-plane capabilities the peer advertised at handshake
+        # (NodeInfo.other): (supports compact relay, supports vote agg).
+        # Set once in add_peer; senders gate the new wire shapes on it,
+        # so a legacy peer only ever sees legacy messages.
+        self.caps = (False, False)
         # (height, round, type) -> set of validator indices known to peer
         self.votes_known: Dict[tuple, set] = {}
         # wake signal for this peer's gossip threads: set whenever our
@@ -165,6 +171,26 @@ class ConsensusReactor(Reactor):
         self._hb_seen: set = set()
         self._hb_seen_height = 0
         self._hb_lock = threading.Lock()
+        # compact consensus gossip (consensus/compact.py): resolved once
+        # at construction like cs._pipeline — a reactor never switches
+        # wire shapes mid-height. Both off = legacy wire byte-for-byte.
+        self._compact = compact.compact_on()
+        self._voteagg = compact.voteagg_on()
+        # peers that failed the compact plane (nack/timeout/bogus data):
+        # exponential backoff, during which both directions fall back to
+        # full part gossip with that peer
+        self._strikes = compact.StrikeLedger()
+        self._compact_lock = threading.Lock()
+        # sender side: peer_id -> {key, deadline, done} for an
+        # unacknowledged compact proposal (parts held back until ack,
+        # nack, or deadline)                       guarded_by _compact_lock
+        self._compact_sent: Dict[str, dict] = {}
+        # cached compact message body per (height, round) — built once,
+        # sent to every capable peer               guarded_by cs._lock
+        self._compact_built: Optional[dict] = None
+        # receiver side: the single in-flight reconstruction
+        #                                          guarded_by _compact_lock
+        self._compact_rx: Optional[dict] = None
 
     def get_channels(self):
         return [
@@ -218,6 +244,7 @@ class ConsensusReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         ps = PeerRoundState()
+        ps.caps = compact.peer_capabilities(peer)
         with self._lock:
             self.peer_states[peer.id] = ps
         peer.set("consensus_peer_state", ps)
@@ -279,6 +306,9 @@ class ConsensusReactor(Reactor):
             self._peer_threads[peer.id] = threads
 
     def remove_peer(self, peer, reason) -> None:
+        with self._compact_lock:
+            self._compact_sent.pop(peer.id, None)
+        self._strikes.forget(peer.id)
         with self._lock:
             self.peer_states.pop(peer.id, None)
             entries = self._peer_threads.pop(peer.id, None)
@@ -442,9 +472,21 @@ class ConsensusReactor(Reactor):
                                 "height": msg["height"],
                                 "round": msg.get("round", -1),
                                 "part": msg["part"]}, peer.id)
+            elif t == "compact_block" and self._compact:
+                self._on_compact_block(peer, ps, msg)
+            elif t == "tx_fetch" and self._compact:
+                self._on_tx_fetch(peer, msg)
+            elif t == "tx_fetch_reply" and self._compact:
+                self._on_tx_fetch_reply(peer, msg)
+            elif t == "compact_ack" and self._compact:
+                self._on_compact_ack(peer, ps, msg)
             # relay promptly: other peers' data-gossip threads may now
             # have a new proposal/part to forward (multi-hop nets would
             # otherwise wait on the idle backstop per hop)
+            if t == "proposal" and self._compact:
+                # a stashed reconstruction may have been waiting for
+                # exactly this proposal to validate against
+                self._compact_retry()
             self._wake_all_gossip()
 
         elif ch_id == VOTE_CHANNEL:
@@ -455,6 +497,16 @@ class ConsensusReactor(Reactor):
                 ps.set_has_vote(v["height"], v["round"], v["type"],
                                 v["validator_index"])
                 self.cs.submit({"type": "vote", "vote": v}, peer.id)
+            elif t == "vote_agg" and self._voteagg:
+                votes = msg.get("votes")
+                if not isinstance(votes, list) or \
+                        not 0 < len(votes) <= compact.MAX_AGG_VOTES:
+                    return  # malformed/oversized aggregate: drop
+                for v in votes:
+                    ps.set_has_vote(v["height"], v["round"], v["type"],
+                                    v["validator_index"])
+                self.cs.submit({"type": "vote_agg", "votes": votes},
+                               peer.id)
 
         elif ch_id == VOTE_SET_BITS_CHANNEL:
             if t == "vote_set_bits":
@@ -516,42 +568,75 @@ class ConsensusReactor(Reactor):
                 ps.wake.clear()
 
     def _gossip_data_pass(self, peer, ps: PeerRoundState) -> bool:
-        """One pass of the data-gossip body: send at most one proposal
-        or block part the peer provably lacks. True when sent."""
+        """One pass of the data-gossip body: send at most one proposal,
+        compact proposal, or block part the peer provably lacks. True
+        when sent."""
         sent = False
         catchup_height = 0
+        now = time.monotonic()
+        if self._compact:
+            # receiver-side reconstruction deadline: ANY peer's data
+            # pass may expire it (the 100ms idle backstop bounds the
+            # check latency), after which full parts flow as before
+            self._compact_rx_tick(now)
         with self.cs._lock:
             rs = self.cs.rs
             p_height, p_round, _, p_has_proposal, p_parts, _ = \
                 ps.snapshot()
             proposal_msg = None
             part_msg = None
+            compact_msg = None
             if rs.height == p_height:
                 # 1) the proposal itself
                 if rs.proposal is not None and not p_has_proposal and \
                         rs.proposal.round == p_round:
                     proposal_msg = {"type": "proposal",
                                     "proposal": rs.proposal.to_obj()}
-                # 2) block parts the peer lacks
-                elif rs.proposal_block_parts is not None:
+                # 2) block parts the peer lacks — short-circuit when the
+                # peer is provably complete (the full-bitarray re-scan
+                # sat in the gossip hot loop at 128 validators)
+                elif rs.proposal_block_parts is not None and \
+                        len(p_parts) < rs.proposal_block_parts.total:
                     parts = rs.proposal_block_parts
-                    for i in range(parts.total):
-                        if i not in p_parts and \
-                                parts.get_part(i) is not None:
-                            part_msg = {
-                                "type": "block_part",
-                                "height": rs.height, "round": rs.round,
-                                "part": parts.get_part(i).to_obj()}
-                            break
+                    mode = "parts"
+                    if self._compact and ps.caps[0]:
+                        mode, compact_msg = self._compact_tx_phase(
+                            peer, ps, rs, now)
+                    # high-bandwidth mode: parts keep streaming while
+                    # an offer is outstanding ("wait") — the ack marks
+                    # them known and stops the stream, so a compact
+                    # miss never costs latency, only a few spare parts
+                    if mode != "send":
+                        for i in range(parts.total):
+                            if i not in p_parts and \
+                                    parts.get_part(i) is not None:
+                                part_msg = {
+                                    "type": "block_part",
+                                    "height": rs.height,
+                                    "round": rs.round,
+                                    "part": parts.get_part(i).to_obj()}
+                                break
             elif 0 < p_height < rs.height:
                 catchup_height = p_height
+        if compact_msg is not None:
+            causal.stamp(compact_msg, compact_msg["height"],
+                         compact_msg["round"])
+            if peer.send(DATA_CHANNEL, encoding.cdumps(compact_msg)):
+                compact.note_compact_sent()
+                return True
+            # send failed: clear the pending entry so parts flow
+            with self._compact_lock:
+                self._compact_sent.pop(peer.id, None)
+            return False
         if catchup_height:
             # catchup: serve parts of the block they're finishing —
             # store reads stay OUTSIDE the state machine's lock (the
             # store is independently thread-safe; holding cs._lock
             # across db I/O would stall vote/proposal processing)
             meta = self.cs.block_store.load_block_meta(catchup_height)
-            if meta is not None:
+            # same has_all short-circuit as the current-height scan
+            if meta is not None and \
+                    len(p_parts) < meta.block_id.parts.total:
                 for i in range(meta.block_id.parts.total):
                     if i not in p_parts:
                         part = self.cs.block_store.load_block_part(
@@ -579,6 +664,406 @@ class ConsensusReactor(Reactor):
                 sent = True
         return sent
 
+    # ------------------------------------------------ compact block relay
+
+    def _compact_tx_phase(self, peer, ps: PeerRoundState, rs,
+                          now: float):
+        """Sender-side compact decision for one data pass (called under
+        cs._lock, peer known to lack parts). Returns (mode, msg):
+        ("send", compact_msg) to offer the compact proposal, ("wait",
+        None) while an offer is outstanding, ("parts", None) to fall
+        back to full part gossip."""
+        key = (rs.height, rs.round)
+        with self._compact_lock:
+            ent = self._compact_sent.get(peer.id)
+            if ent is not None and ent["key"] == key:
+                if ent.get("done"):
+                    return "parts", None
+                if now < ent["deadline"]:
+                    return "wait", None
+                # no ack inside the deadline: strike (backoff future
+                # compact offers to this peer) and ship parts
+                ent["done"] = True
+                self._strikes.strike(peer.id, now, "timeout")
+                return "parts", None
+            if self._strikes.in_backoff(peer.id, now):
+                return "parts", None
+            if rs.proposal is None or rs.proposal_block is None:
+                # nothing compact to offer (we don't hold the full
+                # block yet) — parts flow as they arrive
+                return "parts", None
+            msg = self._build_compact_locked(rs)
+            if msg is None:
+                return "parts", None
+            self._compact_sent[peer.id] = {
+                "key": key,
+                "deadline": now + compact.COMPACT_DEADLINE_S}
+            return "send", msg
+
+    def _build_compact_locked(self, rs) -> Optional[dict]:
+        """The compact message body for the current proposal, built
+        once per (height, round) and cached (under cs._lock). Carries
+        everything a receiver cannot get from its mempool: header,
+        evidence, last commit, the salted short id per tx, and the
+        salt (derived from the proposal signature — unpredictable
+        before signing, identical for every receiver)."""
+        key = (rs.height, rs.round)
+        c = self._compact_built
+        if c is None or c["key"] != key:
+            block = rs.proposal_block
+            obj = block.to_obj()
+            salt = compact.proposal_salt(rs.proposal.signature)
+            c = {"key": key, "msg": {
+                "type": "compact_block",
+                "height": rs.height, "round": rs.round,
+                "salt": salt.hex(),
+                "short_ids": [s.hex() for s in compact.short_ids_for(
+                    salt, block.data.txs)],
+                "header": obj["header"],
+                "evidence": obj["evidence"],
+                "last_commit": obj["last_commit"]}}
+            self._compact_built = c
+        return dict(c["msg"])
+
+    def _on_compact_block(self, peer, ps: PeerRoundState,
+                          msg: dict) -> None:
+        """Receiver side: resolve the short-id list against the
+        mempool, fetch what's missing, rebuild the block onto the
+        canonical PartSet, and feed the parts through cs.submit — the
+        state machine (and its WAL) sees exactly the legacy block_part
+        shape. Any failure nacks, which makes the sender fall back to
+        full part gossip."""
+        now = time.monotonic()
+        try:
+            key = (int(msg["height"]), int(msg["round"]))
+            salt = bytes.fromhex(msg["salt"])
+            short_ids = [bytes.fromhex(s) for s in msg["short_ids"]]
+            header = msg["header"]
+            evidence = msg["evidence"]
+            last_commit = msg["last_commit"]
+        except (KeyError, ValueError, TypeError):
+            self._strikes.strike(peer.id, now, "malformed")
+            self._compact_nack(peer, msg, "failed")
+            return
+        if self._strikes.in_backoff(peer.id, now):
+            compact.note_compact_received("backoff")
+            self._compact_nack(peer, msg, "backoff")
+            return
+        with self.cs._lock:
+            rs = self.cs.rs
+            if key != (rs.height, rs.round):
+                compact.note_compact_received("stale")
+                self._compact_nack(peer, msg, "stale")
+                return
+            if rs.proposal_block is not None:
+                # already have the full block (compact from another
+                # peer, or parts won the race): ack so the sender
+                # marks every part known and stops streaming them
+                compact.note_compact_received("dup")
+                self._compact_mark_sender(ps, rs)
+                self._compact_ack(peer, key, True)
+                return
+            part_size = (self.cs.state.consensus_params
+                         .block_gossip.block_part_size_bytes)
+        rx = {"key": key, "peer": peer.id, "salt": salt,
+              "short_ids": short_ids, "header": header,
+              "evidence": evidence, "last_commit": last_commit,
+              "resolved": {}, "fetching": False, "fetched": False,
+              "part_size": part_size,
+              "deadline": now + compact.COMPACT_DEADLINE_S,
+              "ackers": [peer]}
+        with self._compact_lock:
+            cur = self._compact_rx
+            if cur is not None and cur["key"] == key:
+                # second sender for the same proposal: remember to ack
+                # it too when the in-flight reconstruction lands
+                cur["ackers"].append(peer)
+                compact.note_compact_received("dup")
+                return
+            self._compact_rx = rx
+        if cur is not None:
+            # a reconstruction for an older round was still in flight:
+            # the round check above proves it stale — release its
+            # offerers benignly (their parts flow regardless)
+            for p in cur["ackers"]:
+                self._compact_ack(p, cur["key"], False, "stale")
+        compact.note_compact_received("accepted")
+        self._compact_try_resolve(rx)
+
+    def _compact_try_resolve(self, rx: dict) -> None:
+        """Match every short id against the mempool's hash index; fetch
+        missing txs from the compact sender (bounded) or finish."""
+        mp = getattr(self.cs, "mempool", None)
+        index: Dict[bytes, bytes] = {}
+        if mp is not None and hasattr(mp, "pending_hashes"):
+            salt = rx["salt"]
+            for h in mp.pending_hashes():
+                index[compact.short_id(salt, h)] = h
+        txs: list = []
+        missing: list = []
+        for i, sid in enumerate(rx["short_ids"]):
+            tx = rx["resolved"].get(i)
+            if tx is None:
+                full = index.get(sid)
+                tx = mp.get_by_hash(full) if (
+                    full is not None and hasattr(mp, "get_by_hash")) \
+                    else None
+            if tx is None:
+                missing.append(i)
+                txs.append(None)
+            else:
+                rx["resolved"][i] = tx
+                txs.append(tx)
+        if not missing:
+            self._compact_finish(rx, txs)
+            return
+        if len(missing) > compact.MAX_FETCH or rx["fetching"]:
+            # mempool too cold to win on bytes, or the one bounded
+            # fetch round already ran: fall back to part gossip
+            self._compact_fail_rx(rx, strike_peer="")
+            return
+        rx["fetching"] = True
+        rx["fetched"] = True
+        # a fetch round trip (serve ~MAX_FETCH txs under the sender's
+        # consensus lock) legitimately outlives the base window on a
+        # loaded host — extend; the parts race on in parallel either way
+        rx["deadline"] = max(
+            rx["deadline"],
+            time.monotonic() + compact.FETCH_DEADLINE_S)
+        compact.note_fetch_request(len(missing))
+        rx["ackers"][0].try_send_obj(DATA_CHANNEL, {
+            "type": "tx_fetch", "height": rx["key"][0],
+            "round": rx["key"][1], "indices": missing})
+
+    def _compact_finish(self, rx: dict, txs: list) -> None:
+        """All txs resolved: rebuild the block, split it onto the
+        canonical PartSet, verify it against the signed proposal's
+        part-set header, and submit the parts as plain block_part
+        inputs — bit-identical to the wire path by construction."""
+        from tendermint_tpu.types.block import Block
+        from tendermint_tpu.types.part_set import PartSet
+        height, round_ = rx["key"]
+        try:
+            block = Block.from_obj({
+                "header": rx["header"], "data": {
+                    "txs": [t.hex() for t in txs]},
+                "evidence": rx["evidence"],
+                "last_commit": rx["last_commit"]})
+            data = block.to_bytes()
+            parts = PartSet.from_data(data, rx["part_size"])
+        except Exception:
+            self._compact_fail_rx(rx, strike_peer=rx["peer"],
+                                  reason="bad_block")
+            return
+        with self.cs._lock:
+            rs = self.cs.rs
+            if (rs.height, rs.round) != rx["key"]:
+                self._compact_clear_rx(rx)
+                return
+            if rs.proposal is None:
+                # can't validate against the signed part-set header
+                # yet — hold until the proposal arrives or the
+                # deadline nacks (checked from the data passes)
+                return
+            ok = parts.has_header(rs.proposal.block_parts_header)
+        if not ok:
+            # txs that hash right but a part set that doesn't match
+            # the signed proposal: short-id collision or a lying
+            # sender — either way parts are the truth
+            self._compact_fail_rx(rx, strike_peer=rx["peer"],
+                                  reason="mismatch")
+            return
+        with causal.span("block.reconstruct", height, round_,
+                         parts=parts.total, txs=len(txs),
+                         fetched=int(rx["fetched"])):
+            for i in range(parts.total):
+                self.cs.submit({"type": "block_part", "height": height,
+                                "round": round_,
+                                "part": parts.get_part(i).to_obj()},
+                               rx["peer"])
+        compact.note_reconstruct("fetched" if rx["fetched"] else "hit")
+        with self.cs._lock:
+            rs = self.cs.rs
+            for p in rx["ackers"]:
+                sender_ps = self.peer_states.get(p.id)
+                if sender_ps is not None:
+                    self._compact_mark_sender(sender_ps, rs, rx["key"])
+        for p in rx["ackers"]:
+            self._compact_ack(p, rx["key"], True)
+        self._compact_clear_rx(rx)
+        self._wake_all_gossip()
+
+    def _compact_mark_sender(self, ps: PeerRoundState, rs,
+                             key=None) -> bool:
+        """A peer that offered us a compact proposal provably holds the
+        full block: mark every part known so our data pass never
+        echoes parts back (called under cs._lock)."""
+        if key is not None and (rs.height, rs.round) != key:
+            return False
+        parts = rs.proposal_block_parts
+        if parts is None and rs.proposal is not None:
+            total = rs.proposal.block_parts_header.total
+        elif parts is not None:
+            total = parts.total
+        else:
+            return False
+        ps.set_has_proposal(total)
+        for i in range(total):
+            ps.set_has_part(i)
+        return True
+
+    def _compact_fail_rx(self, rx: dict, strike_peer: str = "",
+                         reason: str = "fallback") -> None:
+        if strike_peer:
+            self._strikes.strike(strike_peer, time.monotonic(), reason)
+        compact.note_reconstruct("fallback")
+        for p in rx["ackers"]:
+            self._compact_ack(p, rx["key"], False, "failed")
+        self._compact_clear_rx(rx)
+        self._wake_all_gossip()
+
+    def _compact_clear_rx(self, rx: dict) -> None:
+        with self._compact_lock:
+            if self._compact_rx is rx:
+                self._compact_rx = None
+
+    def _compact_rx_tick(self, now: float) -> None:
+        """Expire a stuck reconstruction (fetch never answered, or the
+        proposal never arrived): nack every offerer so their parts
+        flow, and strike the peer we fetched from if a fetch was
+        outstanding."""
+        with self._compact_lock:
+            rx = self._compact_rx
+        if rx is None or now < rx["deadline"]:
+            return
+        strike = rx["peer"] if rx["fetching"] else ""
+        self._compact_fail_rx(rx, strike_peer=strike,
+                              reason="fetch_timeout")
+
+    def _compact_retry(self) -> None:
+        """A proposal just arrived: a reconstruction stashed waiting to
+        validate against it can complete now."""
+        with self._compact_lock:
+            rx = self._compact_rx
+        if rx is None:
+            return
+        if all(i in rx["resolved"] for i in range(len(rx["short_ids"]))):
+            self._compact_finish(
+                rx, [rx["resolved"][i]
+                     for i in range(len(rx["short_ids"]))])
+        else:
+            self._compact_try_resolve(rx)
+
+    def _compact_nack(self, peer, msg: dict,
+                      reason: str = "failed") -> None:
+        try:
+            key = (int(msg.get("height", 0)), int(msg.get("round", -1)))
+        except (ValueError, TypeError):
+            return
+        self._compact_ack(peer, key, False, reason)
+
+    def _compact_ack(self, peer, key: tuple, ok: bool,
+                     reason: str = "") -> None:
+        peer.try_send_obj(DATA_CHANNEL, {
+            "type": "compact_ack", "height": key[0], "round": key[1],
+            "ok": bool(ok), "reason": reason})
+
+    def _on_tx_fetch(self, peer, msg: dict) -> None:
+        """Serve missing txs of the current proposal to a peer that is
+        reconstructing it from our compact offer. Bounded by MAX_FETCH;
+        anything we cannot serve simply times out on the requester's
+        side (its deadline nacks and our parts flow)."""
+        indices = msg.get("indices")
+        if not isinstance(indices, list) or \
+                not 0 < len(indices) <= compact.MAX_FETCH:
+            return
+        with self._compact_lock:
+            # the peer is actively reconstructing our offer: give its
+            # ack the same extended window the fetch round trip needs
+            ent = self._compact_sent.get(peer.id)
+            if ent is not None and not ent.get("done"):
+                ent["deadline"] = max(
+                    ent["deadline"],
+                    time.monotonic() + compact.FETCH_DEADLINE_S)
+        out = None
+        with self.cs._lock:
+            rs = self.cs.rs
+            block = rs.proposal_block
+            if block is not None and msg.get("height") == rs.height:
+                n = len(block.data.txs)
+                out = [[i, block.data.txs[i].hex()] for i in indices
+                       if isinstance(i, int) and 0 <= i < n]
+        if out:
+            peer.try_send_obj(DATA_CHANNEL, {
+                "type": "tx_fetch_reply", "height": msg["height"],
+                "round": msg.get("round", -1), "txs": out})
+            compact.note_fetch_served(len(out))
+
+    def _on_tx_fetch_reply(self, peer, msg: dict) -> None:
+        """Fetched txs landed: verify each against its salted short id
+        (a wrong tx here is a lying sender, not a race) and finish."""
+        with self._compact_lock:
+            rx = self._compact_rx
+        if rx is None or rx["peer"] != peer.id:
+            return
+        if rx["key"] != (msg.get("height"), msg.get("round")):
+            return
+        import hashlib
+        txs_in = msg.get("txs")
+        if not isinstance(txs_in, list) or \
+                len(txs_in) > compact.MAX_FETCH:
+            return
+        for item in txs_in:
+            try:
+                i, tx_hex = item
+                i = int(i)
+                tx = bytes.fromhex(tx_hex)
+            except (ValueError, TypeError):
+                continue
+            if not 0 <= i < len(rx["short_ids"]):
+                continue
+            sid = compact.short_id(
+                rx["salt"], hashlib.sha256(tx).digest())
+            if sid != rx["short_ids"][i]:
+                # advertised one tx, served another: strike + fallback
+                self._compact_fail_rx(rx, strike_peer=peer.id,
+                                      reason="bogus_tx")
+                return
+            rx["resolved"][i] = tx
+        if all(i in rx["resolved"]
+               for i in range(len(rx["short_ids"]))):
+            self._compact_finish(
+                rx, [rx["resolved"][i]
+                     for i in range(len(rx["short_ids"]))])
+
+    def _on_compact_ack(self, peer, ps: PeerRoundState,
+                        msg: dict) -> None:
+        """Sender side: ok=True means the peer rebuilt the full block —
+        mark every part known and stop streaming; ok=False means the
+        offer went nowhere — parts keep flowing, and only a FAULT nack
+        (reconstruction actually failed there) strikes. Benign nacks
+        (stale round, receiver backing off or busy) are routine at
+        round edges; striking on them cascades into mutual backoff."""
+        key = (msg.get("height"), msg.get("round"))
+        now = time.monotonic()
+        with self._compact_lock:
+            ent = self._compact_sent.get(peer.id)
+            if ent is None or ent["key"] != key:
+                return
+            ent["done"] = True
+        if msg.get("ok"):
+            with self.cs._lock:
+                rs = self.cs.rs
+                if (rs.height, rs.round) == key and \
+                        rs.proposal_block_parts is not None:
+                    total = rs.proposal_block_parts.total
+                    ps.set_has_proposal(total)
+                    for i in range(total):
+                        ps.set_has_part(i)
+        elif msg.get("reason") not in compact.BENIGN_NACKS:
+            self._strikes.strike(peer.id, now, "nack")
+        ps.wake.set()
+
     # -------------------------------------------------------- gossip: votes
 
     def _gossip_votes_routine(self, peer, ps: PeerRoundState) -> None:
@@ -601,53 +1086,65 @@ class ConsensusReactor(Reactor):
         peer provably lacks; after ~2s of consecutive idle passes run
         the self-heal (forget catchup marks / re-announce round step).
         True when a vote was sent."""
-        vote_msg = None
+        votes = None   # list of vote dicts for one (height, round, type)
         catchup_height = 0
+        # aggregate only toward peers that advertised voteagg/1; a limit
+        # of 1 keeps the single-vote legacy shape byte-for-byte
+        limit = compact.MAX_AGG_VOTES \
+            if self._voteagg and ps.caps[1] else 1
         with self.cs._lock:
             rs = self.cs.rs
             p_height, p_round, p_step, *_ , p_last_commit_round = \
                 (*ps.snapshot(),)
             if p_height == rs.height and rs.votes is not None:
-                vote_msg = self._pick_vote_for(
+                votes = self._pick_votes_for(
                     ps, rs.votes.prevotes(p_round), rs.height, p_round,
-                    VoteType.PREVOTE) or self._pick_vote_for(
+                    VoteType.PREVOTE, limit) or self._pick_votes_for(
                     ps, rs.votes.precommits(p_round), rs.height,
-                    p_round, VoteType.PRECOMMIT)
-                if vote_msg is None and p_round >= 0 and \
+                    p_round, VoteType.PRECOMMIT, limit)
+                if votes is None and p_round >= 0 and \
                         p_round != rs.round:
                     # also our current round's votes (peer may be behind)
-                    vote_msg = self._pick_vote_for(
+                    votes = self._pick_votes_for(
                         ps, rs.votes.prevotes(rs.round), rs.height,
-                        rs.round, VoteType.PREVOTE) or \
-                        self._pick_vote_for(
+                        rs.round, VoteType.PREVOTE, limit) or \
+                        self._pick_votes_for(
                             ps, rs.votes.precommits(rs.round),
-                            rs.height, rs.round, VoteType.PRECOMMIT)
+                            rs.height, rs.round, VoteType.PRECOMMIT,
+                            limit)
             elif p_height + 1 == rs.height and rs.last_commit is not None:
                 # peer finishing our previous height: last-commit votes
-                vote_msg = self._pick_vote_for(
+                votes = self._pick_votes_for(
                     ps, rs.last_commit, p_height, rs.last_commit.round,
-                    VoteType.PRECOMMIT)
+                    VoteType.PRECOMMIT, limit)
             elif 0 < p_height < rs.height:
                 catchup_height = p_height
-        if vote_msg is None and catchup_height:
+        if votes is None and catchup_height:
             # deep catchup: precommits from the stored seen commit —
             # db read outside the state machine's lock
             commit = self.cs.block_store.load_seen_commit(catchup_height)
             if commit is not None:
                 known = ps.known_votes(catchup_height, commit.round(),
                                        VoteType.PRECOMMIT)
+                picked = []
                 for i, pc in enumerate(commit.precommits):
                     if pc is not None and i not in known:
-                        vote_msg = {"type": "vote",
-                                    "vote": pc.to_obj()}
-                        break
-        if vote_msg is not None:
-            vv = vote_msg["vote"]
-            causal.stamp(vote_msg, vv["height"], vv["round"])
+                        picked.append(pc.to_obj())
+                        if len(picked) >= limit:
+                            break
+                votes = picked or None
+        if votes:
+            v0 = votes[0]
+            if len(votes) == 1:
+                vote_msg = {"type": "vote", "vote": v0}
+            else:
+                vote_msg = {"type": "vote_agg", "votes": votes}
+                compact.note_agg_sent(len(votes))
+            causal.stamp(vote_msg, v0["height"], v0["round"])
             if peer.send(VOTE_CHANNEL, encoding.cdumps(vote_msg)):
-                v = vote_msg["vote"]
-                ps.set_has_vote(v["height"], v["round"], v["type"],
-                                v["validator_index"])
+                for v in votes:
+                    ps.set_has_vote(v["height"], v["round"], v["type"],
+                                    v["validator_index"])
             st["idle"] = 0
             return True
         # nothing sendable this pass: after ~2s of consecutive
@@ -676,13 +1173,20 @@ class ConsensusReactor(Reactor):
                               self._our_round_step_msg())
         return False
 
-    def _pick_vote_for(self, ps: PeerRoundState, vote_set, height: int,
-                       round_: int, type_: int) -> Optional[dict]:
-        """First vote in `vote_set` the peer doesn't have."""
+    def _pick_votes_for(self, ps: PeerRoundState, vote_set, height: int,
+                        round_: int, type_: int,
+                        limit: int = 1) -> Optional[list]:
+        """Up to `limit` votes in `vote_set` the peer doesn't have, as
+        wire dicts (same scan order as the pre-aggregation single-vote
+        pick; limit=1 reproduces it exactly). None when empty-handed so
+        the `or` chains read unchanged."""
         if vote_set is None:
             return None
         known = ps.known_votes(height, round_, type_)
+        picked = []
         for i, v in enumerate(vote_set.votes):
             if v is not None and i not in known:
-                return {"type": "vote", "vote": v.to_obj()}
-        return None
+                picked.append(v.to_obj())
+                if len(picked) >= limit:
+                    break
+        return picked or None

@@ -91,6 +91,28 @@ class HeightVoteSet:
             vs = self._sets[(vote.round, vote.type)]
         return vs.add_vote(vote)
 
+    def add_votes(self, round_: int, type_: int, votes: List[Vote],
+                  peer_id: str = ""):
+        """Bulk add for one (round, type) group — the aggregated vote
+        gossip path (consensus/compact.py). Catchup-round bookkeeping
+        runs ONCE for the group, then the whole batch goes through
+        VoteSet.add_votes_batch: one verifier dispatch for every
+        signature instead of one per vote. Returns add_votes_batch's
+        (results, errors) pair."""
+        vs = self._sets.get((round_, type_))
+        if vs is None:
+            if peer_id:
+                rounds = self._peer_catchup.setdefault(peer_id, [])
+                if round_ not in rounds:
+                    if len(rounds) >= self.MAX_CATCHUP_ROUNDS:
+                        raise ValueError(
+                            f"vote round {round_}: peer {peer_id!r} "
+                            f"exhausted its catchup-round allowance")
+                    rounds.append(round_)
+            self._make(round_)
+            vs = self._sets[(round_, type_)]
+        return vs.add_votes_batch(votes)
+
     def pol_info(self) -> Optional[POLInfo]:
         """Highest round with a +2/3 prevote majority for a block
         (consensus/types/height_vote_set.go:145)."""

@@ -218,6 +218,13 @@ class ConsensusState:
                     raise
         elif t == "vote":
             self._try_add_vote(Vote.from_obj(msg["vote"]), peer_id)
+        elif t == "vote_agg":
+            # aggregated vote gossip (consensus/compact.py): the state
+            # machine ALWAYS understands this shape regardless of the
+            # knob — a WAL written with the knob on must replay after
+            # it is turned off
+            self._try_add_votes(
+                [Vote.from_obj(v) for v in msg.get("votes", [])], peer_id)
         elif t == "timeout":
             self._handle_timeout(TimeoutInfo.from_obj(msg["ti"]))
         elif t == "txs_available":
@@ -1089,7 +1096,6 @@ class ConsensusState:
         if vote.height != rs.height:
             return  # height mismatch: ignore
 
-        height = rs.height
         try:
             added = rs.votes.add_vote(vote, peer_id)
         except ConflictingVoteError as e:
@@ -1106,6 +1112,16 @@ class ConsensusState:
         if not added:
             return
         self._publish_vote(vote)
+        self._post_add_vote(vote)
+
+    def _post_add_vote(self, vote: Vote) -> None:
+        """Quorum-driven transitions after a vote of the CURRENT height
+        was counted — shared verbatim between the scalar add path above
+        and the aggregated bulk path (_try_add_votes), which must run
+        these per applied vote so a quorum formed mid-batch acts
+        immediately."""
+        rs = self.rs
+        height = rs.height
 
         if vote.type == VoteType.PREVOTE:
             prevotes = rs.votes.prevotes(vote.round)
@@ -1149,6 +1165,62 @@ class ConsensusState:
                 self._enter_new_round(height, vote.round)
                 self._enter_precommit(height, vote.round)
                 self._enter_precommit_wait(height, vote.round)
+
+    def _try_add_votes(self, votes: List[Vote], peer_id: str) -> None:
+        """Aggregated vote ingestion (consensus/compact.py vote_agg):
+        current-height votes are grouped by (round, type) and each
+        group feeds HeightVoteSet.add_votes — VoteSet.add_votes_batch
+        underneath, ONE verifier dispatch per group instead of one per
+        vote. Stragglers and off-height votes take the scalar path,
+        which already classifies them. A commit triggered by an early
+        vote in the batch advances rs.height mid-loop; remaining groups
+        then re-enter through the scalar path, where votes for the
+        just-committed height are reclassified as last-commit
+        stragglers instead of corrupting the new height's sets."""
+        if not votes:
+            return
+        if len(votes) == 1:
+            self._try_add_vote(votes[0], peer_id)
+            return
+        h0 = self.rs.height
+        groups: dict = {}
+        rest: List[Vote] = []
+        for v in votes:
+            if v is not None and v.height == h0:
+                groups.setdefault((v.round, v.type), []).append(v)
+            else:
+                rest.append(v)
+        for v in rest:
+            self._try_add_vote(v, peer_id)
+        from tendermint_tpu.consensus import compact
+        for (round_, type_), group in groups.items():
+            if self.rs.height != h0 or len(group) == 1:
+                for v in group:
+                    self._try_add_vote(v, peer_id)
+                continue
+            with self._cspan("votes.agg", h0, round_,
+                             votes=len(group), vtype=int(type_)):
+                try:
+                    results, errors = self.rs.votes.add_votes(
+                        round_, type_, group, peer_id)
+                except ValueError as e:
+                    self._log(f"bad vote batch from {peer_id!r}: {e}")
+                    continue
+            compact.note_agg_applied(len(group))
+            for pos, err in errors:
+                if isinstance(err, ConflictingVoteError):
+                    self._file_duplicate_vote_evidence(group[pos], err)
+                else:
+                    self._log(f"bad vote from {peer_id!r}: {err}")
+            for v, added in zip(group, results):
+                if not added:
+                    continue
+                self._publish_vote(v)
+                if self.rs.height == h0:
+                    # a transition fired by an earlier vote may have
+                    # committed the height — stale post-processing
+                    # against the NEW height's sets must not run
+                    self._post_add_vote(v)
 
     def _publish_vote(self, vote: Vote) -> None:
         if self.event_bus is not None and not self.replay_mode:

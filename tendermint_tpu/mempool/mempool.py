@@ -21,6 +21,7 @@ on an async channel for the same reason, mempool/mempool.go:100-105).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import struct
 import threading
@@ -101,6 +102,12 @@ class Mempool:
         self.cache = TxCache(getattr(cfg, "cache_size", 100_000))
         self.txs = CList()
         self._tx_elements: dict = {}  # tx bytes -> CElement
+        # sha256(tx) -> tx for every PENDING tx, maintained in lockstep
+        # with _tx_elements: O(1) lookups for the RPC tx front door and
+        # the compact-block reconstruction path (consensus/compact.py),
+        # which must resolve a proposal's tx-hash list without hashing
+        # the whole mempool per proposal
+        self._by_hash: dict = {}
         self.height = height
         self.counter = 0
         self.proxy_mtx = threading.RLock()  # the reference's proxyMtx
@@ -137,6 +144,7 @@ class Mempool:
             self.cache.reset()
             self.txs.clear()
             self._tx_elements.clear()
+            self._by_hash.clear()
             _m_size.set(0)
 
     def close(self) -> None:
@@ -211,6 +219,7 @@ class Mempool:
                 self.counter += 1
                 mtx = MempoolTx(self.counter, self.height, tx)
                 self._tx_elements[tx] = self.txs.push_back(mtx)
+                self._by_hash[hashlib.sha256(tx).digest()] = tx
                 if telemetry.enabled():
                     _m_added.inc()
                     _m_size.set(len(self.txs))
@@ -264,6 +273,7 @@ class Mempool:
                     self.counter += 1
                     mtx = MempoolTx(self.counter, self.height, tx)
                     self._tx_elements[tx] = self.txs.push_back(mtx)
+                    self._by_hash[hashlib.sha256(tx).digest()] = tx
                     _m_added.inc()
                 else:
                     self.cache.remove(tx)
@@ -293,6 +303,18 @@ class Mempool:
 
     # -------------------------------------------------------------- reap/update
 
+    def get_by_hash(self, tx_hash: bytes) -> Optional[bytes]:
+        """O(1) pending-tx lookup by sha256(tx) — the compact-block
+        reconstruction path and the RPC tx front door."""
+        with self.proxy_mtx:
+            return self._by_hash.get(tx_hash)
+
+    def pending_hashes(self) -> List[bytes]:
+        """Snapshot of every pending tx's sha256 (insertion order) —
+        one pass for the compact plane's salted short-id index."""
+        with self.proxy_mtx:
+            return list(self._by_hash.keys())
+
     def reap(self, max_txs: int = -1) -> List[bytes]:
         """Up to max_txs pending txs in order (-1 = all)
         (mempool/mempool.go:331)."""
@@ -316,6 +338,7 @@ class Mempool:
             el = self._tx_elements.pop(tx, None)
             if el is not None:
                 self.txs.remove(el)
+                self._by_hash.pop(hashlib.sha256(tx).digest(), None)
                 _m_removed.labels("committed").inc()
             # committed txs stay in cache: re-submission is a dup
         if self.recheck and len(self.txs) > 0:
@@ -335,5 +358,6 @@ class Mempool:
             if not res.ok:
                 self.txs.remove(el)
                 self._tx_elements.pop(tx, None)
+                self._by_hash.pop(hashlib.sha256(tx).digest(), None)
                 self.cache.remove(tx)
                 _m_removed.labels("recheck").inc()

@@ -74,6 +74,13 @@ class Node:
         from tendermint_tpu import pipeline as _pipeline
         _pipeline.configure(mode=getattr(config.base, "pipeline", "auto"))
 
+        # compact consensus gossip (env TM_TPU_COMPACT / TM_TPU_VOTE_AGG
+        # win inside the resolvers; both off = legacy wire byte-for-byte)
+        from tendermint_tpu.consensus import compact as _compact
+        _compact.configure(
+            compact_mode=getattr(config.base, "compact", "auto"),
+            voteagg_mode=getattr(config.base, "vote_agg", "auto"))
+
         # async reactor core (env TM_TPU_REACTOR wins inside resolve();
         # "threads" restores the per-connection thread plane exactly).
         # The ReactorLoop itself is created lazily below, only when a
@@ -322,10 +329,14 @@ class Node:
             node_key = NodeKey.load_or_generate(
                 self.config.path("config/node_key.json"))
         self.node_key = node_key
+        # compact-plane capabilities ride the handshake's `other` list;
+        # empty (hence byte-identical handshake) with the knobs off
+        from tendermint_tpu.consensus import compact as _compact
         node_info = NodeInfo(
             pubkey=node_key.pubkey,
             moniker=getattr(self.config.base, "moniker", "node"),
-            network=self.gen_doc.chain_id)
+            network=self.gen_doc.chain_id,
+            other=_compact.wire_capabilities())
         self.switch = Switch(self.config.p2p, node_key, node_info,
                              loop=self._ensure_loop())
 

@@ -507,7 +507,20 @@ class RPCCore:
         query = f"tm.event = 'Tx' AND {TagTxHash} = '{tx_hash}'"
         sub = bus.subscribe(subscriber, query)
         try:
-            check = self._check_tx(tx)
+            try:
+                check = self._check_tx(tx)
+            except RPCError as e:
+                # a re-broadcast of a tx that is STILL PENDING should
+                # wait for its commit, not error — O(1) to distinguish
+                # from a genuine duplicate via the mempool hash index
+                mp = self.env.mempool
+                if "already in cache" in str(e.args) and \
+                        hasattr(mp, "get_by_hash") and mp.get_by_hash(
+                            hashlib.sha256(tx).digest()) is not None:
+                    from tendermint_tpu.abci.types import ResultCheckTx
+                    check = ResultCheckTx(code=0, log="tx already pending")
+                else:
+                    raise
             if not check.ok:
                 return jsonify({"check_tx": check.to_obj(),
                                 "deliver_tx": None, "hash": tx_hash,
@@ -712,6 +725,14 @@ class RPCCore:
             raise RPCError(-32000, "transaction indexing is disabled")
         result = indexer.get(hash)
         if result is None:
+            # O(1) mempool probe (Mempool.get_by_hash): a pending tx
+            # gets a distinguishable error instead of plain not-found
+            mp = self.env.mempool
+            if hasattr(mp, "get_by_hash") and \
+                    mp.get_by_hash(hash) is not None:
+                raise RPCError(
+                    -32000, f"tx {hash.hex()} is pending in the "
+                    f"mempool (not yet committed)")
             raise RPCError(-32000, f"tx {hash.hex()} not found")
         out = dict(result)
         if prove:

@@ -70,12 +70,17 @@ from tendermint_tpu.utils import knobs
 #   queue.saturated  queue-observatory watchdog episode (kind + depth)
 #   slo.sample       a sampled tx completed delivery (hash + e2e ms) —
 #                    the SLO plane's join key into the span timeline
+#   block.reconstruct  compact relay: block rebuilt from mempool txs
+#                    (span; outcome + missing-tx count ride as args)
+#   votes.agg        one aggregated vote batch applied through the
+#                    bulk VoteSet path (span; vote count rides as arg)
 SPAN_CATALOG = frozenset((
     "height.begin", "propose", "proposal.recv", "part.first",
     "block.full", "quorum.prevote", "quorum.precommit",
     "verify.dispatch", "apply", "flush", "wal.fsync", "commit",
     "p2p.recv", "mempool.recv", "stall",
     "snapshot.restore", "sync.chunk", "queue.saturated", "slo.sample",
+    "block.reconstruct", "votes.agg",
 ))
 
 DEFAULT_CAPACITY = 65536

@@ -137,6 +137,19 @@ CATALOG: tuple[Knob, ...] = (
          "streaming proposal gossip, overlapped finalize, group-commit "
          "persistence): auto|on|off. off = serial path byte-for-byte.",
          "pipeline.py"),
+    # -- compact consensus gossip ------------------------------------------
+    Knob("TM_TPU_COMPACT", "str", "auto (on)", "base.compact",
+         "Compact block relay: gossip header + salted short tx ids, "
+         "receivers rebuild the block from their mempool and fetch "
+         "only missing txs, falling back to full part gossip on miss "
+         "or timeout. auto|on|off; off = legacy wire byte-for-byte.",
+         "consensus/compact.py, consensus/reactor.py"),
+    Knob("TM_TPU_VOTE_AGG", "str", "auto (on)", "base.vote_agg",
+         "Aggregated vote gossip: batch every vote a peer lacks for "
+         "one (height, round, type) into a single message, verified "
+         "as ONE coalesced dispatch via VoteSet.add_votes_batch. "
+         "auto|on|off; off = one scalar vote message per pass.",
+         "consensus/compact.py, consensus/reactor.py"),
     # -- telemetry ---------------------------------------------------------
     Knob("TM_TPU_TELEMETRY", "bool", "unset (config decides, on)",
          "base.telemetry",
