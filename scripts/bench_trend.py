@@ -144,6 +144,18 @@ EXTRACTORS = (
      "proof.verify_us", "us", "down"),
     ("height_wall_p50_ms", "BENCH_trace.json",
      "attribution.per_height[-1].wall_ms", "ms", "down"),
+    # the ISSUE-19 serving plane: the open-loop knee (highest offered
+    # rate the multi-process front door absorbs with goodput intact),
+    # tail latency AT that knee, and the edge read tier's capacity
+    # scaling at 2 replicas — regressions mean the serving plane
+    # saturates earlier, answers slower at the knee, or replica
+    # fan-out stopped adding certified-read capacity
+    ("load_knee_tx_per_sec", "BENCH_load.json",
+     "knee.offered_rate", "ops/sec", "up"),
+    ("load_p99_at_knee_ms", "BENCH_load.json",
+     "knee.p99_ms", "ms", "down"),
+    ("load_replica_scaling_2x", "BENCH_load.json",
+     "replica_scaling.scaling_2x", "x", "up"),
 )
 
 _STEP_RE = re.compile(

@@ -32,6 +32,7 @@ KNOWN_SUBSYSTEMS = {
     "chaos", "mesh", "pipeline", "partset", "trace",
     "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
     "slo", "shard", "statetree", "compact", "voteagg",
+    "edge", "load", "deploy",
 }
 
 INSTRUMENTED_MODULES = [
@@ -62,6 +63,9 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.shard.router",       # tm_shard_* router/height plane
     "tendermint_tpu.statetree.store",    # tm_statetree_* commit/proof plane
     "tendermint_tpu.consensus.compact",  # tm_compact_*/tm_voteagg_* gossip
+    "tendermint_tpu.serving.edge",       # tm_edge_* certified read tier
+    "tendermint_tpu.serving.loadgen",    # tm_load_* open-loop harness
+    "tendermint_tpu.serving.deploy",     # tm_deploy_* process driver
 ]
 
 # Causal span names follow the same closed-catalog discipline as metric

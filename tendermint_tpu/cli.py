@@ -261,6 +261,19 @@ def cmd_show_node_id(args) -> int:
     return 0
 
 
+def cmd_replica(args) -> int:
+    """Run an edge read replica (serving/edge.py): a follower node
+    with NO validator key serving lite-certified reads."""
+    from tendermint_tpu.serving.edge import run_replica
+    return run_replica(args)
+
+
+def cmd_shardset(args) -> int:
+    """Run one sharded front-door process (serving/deploy.py)."""
+    from tendermint_tpu.serving.deploy import run_shardset
+    return run_shardset(args)
+
+
 def cmd_testnet(args) -> int:
     """Emit an N-validator testnet file tree (cmd testnet.go:97): a shared
     genesis listing every validator, per-node priv_validator + node_key +
@@ -403,6 +416,33 @@ def main(argv=None) -> int:
     sp = sub.add_parser("replay_console",
                         help="interactively replay the consensus WAL")
     sp.set_defaults(fn=lambda a: cmd_replay(a, console=True))
+
+    sp = sub.add_parser("replica",
+                        help="run an edge read replica (keyless "
+                             "follower + lite-certified reads)")
+    sp.add_argument("--app", default="kvstore",
+                    choices=["kvstore", "counter"])
+    sp.add_argument("--rpc-laddr", default="",
+                    help="serve the replica RPC surface here")
+    sp.add_argument("--persistent-peers", default="",
+                    help="validators to follow (id@host:port,...)")
+    sp.add_argument("--max-lag", type=int, default=0,
+                    help="healthz staleness threshold in heights "
+                         "(0 = TM_TPU_EDGE_MAX_LAG / default)")
+    sp.add_argument("--max-seconds", type=float, default=0)
+    sp.add_argument("--state-sync", action="store_true",
+                    help="bootstrap from a peer snapshot before "
+                         "tailing via fast sync")
+    sp.set_defaults(fn=cmd_replica)
+
+    sp = sub.add_parser("shardset",
+                        help="run N chains behind one sharded RPC "
+                             "front door in this process")
+    sp.add_argument("--shards", type=int, default=2)
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:46657",
+                    help="front-door RPC listen address")
+    sp.add_argument("--max-seconds", type=float, default=0)
+    sp.set_defaults(fn=cmd_shardset)
 
     sp = sub.add_parser("lite", help="light-client RPC proxy")
     sp.add_argument("--node-addr", default="http://127.0.0.1:46657")

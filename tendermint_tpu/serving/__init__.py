@@ -1,0 +1,22 @@
+"""Serving plane (ISSUE 19) — the pieces that turn one-process benches
+into a deployed, loadable, horizontally-readable net:
+
+- ``topology``: declarative multi-process topologies (validator nets
+  with edge replicas, or a sharded front-door process) materialized
+  into per-node homes + configs + persistent_peers.
+- ``deploy``: the deployment driver — spawn the processes, supervise
+  them (crash => bounded restart), optionally shape the WAN between
+  validators with the chaos WireProxy, tear down leak-clean.
+- ``edge``: stateless read replicas. A replica is a Node WITHOUT a
+  validator key that follows the chain via statesync + fast-sync and
+  serves reads only through a ContinuousCertifier advancing from its
+  OWN stores — staleness (certified-height lag) is stamped on every
+  response and flips /healthz past TM_TPU_EDGE_MAX_LAG.
+- ``loadgen``: the open-loop load harness — a selector-based fleet of
+  virtual clients issuing a Poisson-paced mix at a FIXED offered rate
+  regardless of response latency, swept across rates to find the knee
+  (docs/serving.md: why closed-loop load tests lie).
+"""
+
+from tendermint_tpu.serving.topology import Topology, ProcSpec  # noqa: F401
+from tendermint_tpu.serving.deploy import Deployment  # noqa: F401

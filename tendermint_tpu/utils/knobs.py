@@ -236,6 +236,14 @@ CATALOG: tuple[Knob, ...] = (
          "process-default verifier and one ReactorLoop; 0 = single-"
          "chain shape.",
          "shard/__init__.py"),
+    # -- edge serving plane ------------------------------------------------
+    Knob("TM_TPU_EDGE_MAX_LAG", "int", "50", "",
+         "Staleness threshold (heights) for an edge read replica: when "
+         "certified-height lag exceeds it — or continuous certification "
+         "has failed — the replica's /healthz flips not-ok so load "
+         "balancers drain it. Every response still carries the honest "
+         "lag either way.",
+         "serving/edge.py"),
     # -- chaos plane -------------------------------------------------------
     Knob("TM_TPU_CHAOS", "spec", "off", "base.chaos",
          "Link fault spec, e.g. drop=0.05,delay=0.1,delay_ms=30,seed=7.",

@@ -1,0 +1,237 @@
+"""Edge serving plane (ISSUE 19): certifier-follower staleness
+honesty, replica self-verification, forged-proof rejection through a
+replica, and the PR 12 admission plane on the edge tier driven by the
+open-loop harness."""
+
+import time
+
+import pytest
+
+from tendermint_tpu import telemetry
+from tendermint_tpu.serving.edge import (
+    CertifierFollower,
+    ReplicaCore,
+    make_replica_server,
+)
+from tendermint_tpu.shard import ShardSet
+from tendermint_tpu.shard.reads import CertifiedReader, ReadProofError
+
+
+def wait_for(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def serving1(monkeypatch):
+    """One tree-backed in-process chain — the stores a replica's
+    follower certifies from (the follower only reads block/state
+    stores, so a local committing node is a faithful stand-in for a
+    fast-synced one)."""
+    monkeypatch.setenv("TM_TPU_STATE_TREE", "on")
+    s = ShardSet(1, chain_prefix="tserve")
+    s.start()
+    try:
+        assert wait_for(lambda: s.frontier() >= 3), s.heights()
+        yield s
+    finally:
+        s.stop()
+
+
+def _put_and_settle(node, key, value):
+    node.mempool.check_tx(key + b"=" + value)
+
+    def provable():
+        h = node.block_store.height()
+        if h < 2:
+            return False
+        res = node.app_conns.query.query("", key, height=h - 1,
+                                         prove=True)
+        return res.code == 0 and res.value == value
+    assert wait_for(provable), node.height
+    return node
+
+
+# --------------------------------------------- staleness honesty --
+
+def test_follower_certifies_to_frontier_and_reports_zero_lag(serving1):
+    node = serving1.nodes[0]
+    f = CertifierFollower(node, max_lag=5)
+    assert f.catch_up() > 0
+    assert f.certified_height == node.block_store.height() - f.lag
+    assert f.lag <= 1          # frontier may move mid-assert
+    st = f.status()
+    assert st["role"] == "replica" and st["failed"] is None
+    assert st["trust_anchor"] == 0     # genesis-seeded
+    assert telemetry.value("edge_certified_height") == \
+        f.certified_height
+
+
+def test_follower_behind_by_k_reports_honest_lag_and_flips_healthz(
+        serving1):
+    """A replica behind by k heights says so in every response, and
+    /healthz flips once k passes the configured threshold — staleness
+    is never hidden (the satellite-3 surface)."""
+    node = serving1.nodes[0]
+    assert wait_for(lambda: node.block_store.height() >= 5)
+    f = CertifierFollower(node, max_lag=2)
+    h = node.block_store.height()
+    f.catch_up(up_to=h - 4)
+    assert f.certified_height == h - 4
+    assert f.lag >= 4          # honest: frontier only grows
+    assert not f.ok            # 4 > max_lag=2
+    core = ReplicaCore.__new__(ReplicaCore)
+    from tendermint_tpu.rpc.core import RPCCore, RPCEnv
+    core._core = RPCCore(RPCEnv.from_node(node))
+    core.node, core.follower = node, f
+    doc = core.status()
+    assert doc["edge"]["certified_height"] == h - 4
+    assert doc["edge"]["lag"] >= 4
+    hz = core.healthz()
+    assert hz["ok"] is False and hz["edge"]["ok"] is False
+    # catching up recovers the verdict
+    f.catch_up()
+    assert f.ok
+    assert core.healthz()["edge"]["ok"] is True
+
+
+def test_forged_commit_in_stores_freezes_trust_and_fails_health(
+        serving1, monkeypatch):
+    """A forged commit below the frontier halts certification exactly
+    where it broke: certified_height freezes, the failure is recorded,
+    lag grows honestly, and /healthz goes not-ok."""
+    from tendermint_tpu.shard import reads as _reads
+
+    node = serving1.nodes[0]
+    orig = _reads.full_commit_at
+
+    def forged(store, state_store, height):
+        import copy
+        fc = orig(store, state_store, height)
+        if fc is not None and height >= 2:
+            fc = copy.deepcopy(fc)    # never mutate live-store objects
+            for v in fc.signed_header.commit.precommits:
+                if v is not None:
+                    sig = bytearray(v.signature)
+                    sig[0] ^= 0xFF
+                    v.signature = bytes(sig)
+        return fc
+
+    monkeypatch.setattr(_reads, "full_commit_at", forged)
+    f = CertifierFollower(node, max_lag=100)
+    f.catch_up()
+    assert f.failed is not None and "height 2" in f.failed
+    assert f.certified_height == 1     # trust never passed the forgery
+    assert not f.ok
+    assert (telemetry.value("edge_cert_failures_total") or 0) >= 1
+    # catch_up refuses to advance past the recorded failure
+    before = f.certified_height
+    f.catch_up()
+    assert f.certified_height == before
+
+
+# ------------------------------------------ replica-served reads --
+
+def test_replica_read_serves_verified_proof_and_stamps_staleness(
+        serving1):
+    node = serving1.nodes[0]
+    _put_and_settle(node, b"edge/k1", b"v1")
+    f = CertifierFollower(node, max_lag=50)
+    f.catch_up()
+    server, core = make_replica_server(node, f)
+    doc = core.replica_read(b"edge/k1")
+    assert doc["edge"]["certified_height"] >= doc["height"]
+    assert doc["value_proof"] is not None
+    assert bytes.fromhex(doc["value"]) == b"v1"
+    assert telemetry.value("edge_reads_total",
+                           {"result": "verified"})
+    # an untrusting client re-verifies the whole chain of custody
+    # from the GENESIS valset — e2e through a replica response
+    from tendermint_tpu.lite.certifier import ContinuousCertifier
+    cert = ContinuousCertifier(node.gen_doc.chain_id,
+                               node.state_store.load_validators(1))
+    CertifiedReader.verify(doc, cert)
+    assert cert.certified_height >= doc["height"]
+
+
+def test_replica_self_verification_rejects_tampered_value(
+        serving1, monkeypatch):
+    """A replica whose read path hands out a value that does not match
+    the certified proof REFUSES to serve it (forged-proof rejection
+    e2e through the replica, server side)."""
+    from tendermint_tpu.rpc.server import RPCError
+    from tendermint_tpu.shard import reads as _reads
+
+    node = serving1.nodes[0]
+    _put_and_settle(node, b"edge/forged", b"honest")
+    f = CertifierFollower(node, max_lag=50)
+    f.catch_up()
+    server, core = make_replica_server(node, f)
+    orig = _reads.serve_read
+
+    def tampered(n, key, since_height=0, **kw):
+        d = orig(n, key, since_height=since_height, **kw)
+        d["value"] = b"forged!".hex()
+        return d
+
+    monkeypatch.setattr(_reads, "serve_read", tampered)
+    before = telemetry.value("edge_reads_total",
+                             {"result": "rejected"}) or 0
+    with pytest.raises(RPCError, match="self-verification failed"):
+        core.replica_read(b"edge/forged")
+    assert telemetry.value("edge_reads_total",
+                           {"result": "rejected"}) == before + 1
+    # the client-side certifier rejects the same tampering
+    monkeypatch.setattr(_reads, "serve_read", orig)
+    doc = core.replica_read(b"edge/forged")
+    doc["value"] = b"forged!".hex()
+    from tendermint_tpu.lite.certifier import ContinuousCertifier
+    cert = ContinuousCertifier(node.gen_doc.chain_id,
+                               node.state_store.load_validators(1))
+    with pytest.raises(ReadProofError):
+        CertifiedReader.verify(doc, cert)
+
+
+# ------------------------------- admission control at the edge --
+
+def test_edge_admission_sheds_conns_and_rate_limits_under_harness(
+        serving1, monkeypatch):
+    """Satellite 2: the PR 12 admission plane guards replica RPC
+    servers — over-cap connections get the 503 handshake refusal, an
+    over-rate client gets structured -32005 — driven by the open-loop
+    fleet itself, which classifies both shed modes."""
+    from tendermint_tpu.serving.loadgen import OpenLoopFleet, op_query_prove
+
+    monkeypatch.setenv("TM_TPU_RPC_MAX_CONNS", "20")
+    monkeypatch.setenv("TM_TPU_RPC_RATE", "40")
+    node = serving1.nodes[0]
+    _put_and_settle(node, b"edge/adm", b"v")
+    f = CertifierFollower(node, max_lag=50)
+    f.catch_up()
+    loop = serving1.ensure_loop()
+    if not loop.running:
+        loop.start()
+    server, core = make_replica_server(node, f, loop=loop)
+    host, port = server.serve("127.0.0.1", 0)
+    fleet = OpenLoopFleet(host, port, seed=7)
+    try:
+        admitted = fleet.connect(30)
+        assert admitted <= 20
+        assert fleet.shed_conns >= 10       # conn-cap refusals
+        point = fleet.run(
+            2.0, rate=200.0,
+            mix=[("query_prove", 1.0, op_query_prove(
+                keyspace=1, prefix="edge/adm"))],
+            drain_s=3.0)
+        # one client IP at 5x the bucket rate: most ops shed with the
+        # structured rate-limit error, the rest complete
+        assert point["errors"]["rate_limited"] > 0
+        assert point["completed_ok"] > 0
+        assert point["per_kind"]["query_prove"]["offered"] >= 300
+    finally:
+        fleet.close()
+        server.stop()
