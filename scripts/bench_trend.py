@@ -156,6 +156,13 @@ EXTRACTORS = (
      "knee.p99_ms", "ms", "down"),
     ("load_replica_scaling_2x", "BENCH_load.json",
      "replica_scaling.scaling_2x", "x", "up"),
+    # the ISSUE-20 static-analysis plane: full tmlint wall time (AST
+    # checkers + metrics registry + the inter-procedural taint pass
+    # over the project call graph) — it runs inside tier-1, so a
+    # superlinear blowup in the flowgraph/taint traversal shows up
+    # here before it makes CI miserable
+    ("lint_wall_seconds", "LINT_report.json",
+     "lint_seconds", "s", "down"),
 )
 
 _STEP_RE = re.compile(

@@ -5,7 +5,12 @@
                                          #   + metrics registry lint
     python scripts/lint.py --no-metrics  # skip the (import-heavy)
                                          #   metrics half — pure AST
+    python scripts/lint.py --taint       # add the inter-procedural
+                                         #   determinism taint pass
     python scripts/lint.py --json        # also write LINT_report.json
+                                         #   (runs the taint pass too)
+    python scripts/lint.py --graph-stats # print call-graph resolution
+                                         #   stats (flowgraph) and exit
     python scripts/lint.py --knobs-md    # (re)generate docs/knobs.md
                                          #   from the knob catalog
 
@@ -64,15 +69,30 @@ def main(argv=None) -> int:
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the metrics registry lint (no heavy "
                          "imports; pure-AST run)")
-    ap.add_argument("--max-pragmas", type=int, default=10,
+    ap.add_argument("--taint", action="store_true",
+                    help="run the inter-procedural consensus-"
+                         "determinism taint pass (implied by --json)")
+    ap.add_argument("--graph-stats", action="store_true",
+                    help="print project call-graph resolution stats "
+                         "(analysis.flowgraph) as JSON and exit")
+    ap.add_argument("--max-pragmas", type=int, default=15,
                     help="fail when the tree carries more allow "
-                         "pragmas than this (default 10)")
+                         "pragmas than this (default 15)")
     ap.add_argument("paths", nargs="*",
                     help="scan set override (default: the package, "
                          "scripts/, bench*.py, benchmarks/)")
     args = ap.parse_args(argv)
 
+    import time
+    t0 = time.monotonic()
+
     from tendermint_tpu.utils import knobs
+    if args.graph_stats:
+        from tendermint_tpu.analysis.flowgraph import FlowGraph
+        graph = FlowGraph.build(REPO)
+        print(json.dumps(graph.stats(), indent=1, sort_keys=True))
+        return 0
+
     if args.knobs_md:
         os.makedirs(os.path.dirname(KNOBS_MD), exist_ok=True)
         with open(KNOBS_MD, "w", encoding="utf-8") as f:
@@ -82,19 +102,27 @@ def main(argv=None) -> int:
         return 0
 
     from tendermint_tpu.analysis import run_tree
+    from tendermint_tpu.analysis.checkers import all_checkers
     from tendermint_tpu.analysis.engine import Finding
     findings, pragmas, n_files = run_tree(
         REPO, paths=args.paths or None)
     findings += check_knobs_md()
 
-    checkers_run = ["determinism", "lock-discipline", "knob-registry",
-                    "exception-hygiene", "pragma"]
+    checkers_run = [c.id for c in all_checkers()] + ["pragma"]
     metrics_summary = "skipped"
     if not args.no_metrics:
         from tendermint_tpu.analysis.checkers import metrics
         findings += metrics.run()
         metrics_summary = metrics.run.summary or "failed"
         checkers_run.append("metrics")
+
+    taint_stats = None
+    if args.taint or args.json:
+        from tendermint_tpu.analysis.checkers.taint import run_taint
+        taint_report = run_taint(REPO)
+        findings += taint_report.findings
+        taint_stats = taint_report.stats
+        checkers_run.append("taint")
 
     if len(pragmas) > args.max_pragmas:
         findings.append(Finding(
@@ -108,6 +136,8 @@ def main(argv=None) -> int:
             "files_scanned": n_files,
             "checkers": checkers_run,
             "metrics": metrics_summary,
+            "taint": taint_stats,
+            "lint_seconds": round(time.monotonic() - t0, 3),
             "clean": not findings,
             "findings": [f.to_obj() for f in findings],
             "pragmas": [p.to_obj() for p in pragmas],
@@ -123,8 +153,12 @@ def main(argv=None) -> int:
         print(f"lint: FAILED — {len(findings)} finding(s) across "
               f"{n_files} files")
         return 1
+    taint_summary = "skipped" if taint_stats is None else (
+        f"{taint_stats['reachable_functions']} reachable fns, "
+        f"{taint_stats['seam_cuts']} seam cuts")
     print(f"lint: OK — {n_files} files, "
-          f"{len(pragmas)} pragma(s), metrics: {metrics_summary}")
+          f"{len(pragmas)} pragma(s), metrics: {metrics_summary}, "
+          f"taint: {taint_summary}")
     return 0
 
 

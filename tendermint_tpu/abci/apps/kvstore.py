@@ -235,6 +235,7 @@ class KVStoreApp(BaseApplication):
             # tests set TM_KVSTORE_UNSAFE_VAL_UPDATES to bypass the guard
             # and drive the core's ApplyBlockError/halt path end-to-end
             import os as _os
+            # tmlint: allow(taint): test-only fault hook in utils/fail.py spirit; never set outside tests that deliberately break the guard
             guard = not _os.environ.get("TM_KVSTORE_UNSAFE_VAL_UPDATES")
             if update.power == 0:
                 if guard and update.pubkey not in self._validators:

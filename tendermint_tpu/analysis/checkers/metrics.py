@@ -32,7 +32,7 @@ KNOWN_SUBSYSTEMS = {
     "chaos", "mesh", "pipeline", "partset", "trace",
     "snapshot", "sync", "prune", "prof", "queue", "loop", "wire",
     "slo", "shard", "statetree", "compact", "voteagg",
-    "edge", "load", "deploy",
+    "edge", "load", "deploy", "divergence",
 }
 
 INSTRUMENTED_MODULES = [
@@ -58,6 +58,7 @@ INSTRUMENTED_MODULES = [
     "tendermint_tpu.telemetry.queues",   # tm_queue_* backpressure plane
     "tendermint_tpu.p2p.conn.loop",      # tm_loop_* reactor-loop core
     "tendermint_tpu.rpc.aserver",        # tm_rpc_* async front door
+    "tendermint_tpu.analysis.divergence",  # tm_divergence_* digest plane
     "tendermint_tpu.chaos.wire",         # tm_wire_* TCP fault proxy
     "tendermint_tpu.telemetry.slo",      # tm_slo_* tx-lifecycle plane
     "tendermint_tpu.shard.router",       # tm_shard_* router/height plane

@@ -277,7 +277,11 @@ class Engine:
     def _finish_pragmas(self) -> None:
         """Pragma hygiene: every allow() must carry a justification and
         actually suppress something (stale pragmas rot into lies)."""
-        known = {c.id for c in self.checkers} | {"metrics"}
+        # "metrics" and "taint" run outside the AST engine (registry
+        # import / call-graph pass), so their pragmas are collected here
+        # but used elsewhere: accept the ids, and leave staleness
+        # policing to the passes that actually consume them.
+        known = {c.id for c in self.checkers} | {"metrics", "taint"}
         for p in self.pragmas:
             if p.checker not in known:
                 self._report(Finding(
@@ -288,7 +292,7 @@ class Engine:
                     "pragma", p.path, p.line,
                     f"allow({p.checker}) carries no justification — "
                     f"say why the rule does not apply here"))
-            elif not p.used and p.checker != "metrics":
+            elif not p.used and p.checker not in ("metrics", "taint"):
                 self._report(Finding(
                     "pragma", p.path, p.line,
                     f"allow({p.checker}) suppresses nothing — stale "

@@ -24,6 +24,13 @@ faults fire:
               inside poll() as committed heights' data becomes
               readable from a live node's stores (exactly what an RPC
               provider would serve).
+  divergence  every node's per-height transition digest (block bytes,
+              ABCI responses, validator updates, app_hash — see
+              analysis/divergence.py) is bit-identical across the net.
+              Strictly stronger than agreement: two nodes can commit
+              the same block yet fork on ABCI responses or app_hash,
+              and the digest pinpoints the first such height. Enabled
+              by attach_divergence() when TM_TPU_DIVERGENCE is on.
 
 Violations are recorded (never raised mid-run — the runner must keep
 driving so the trace shows what happened AFTER the violation) and
@@ -42,7 +49,7 @@ from tendermint_tpu.chaos.byzantine import double_sign_key
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 
 INVARIANTS = ("agreement", "validity", "evidence", "liveness",
-              "certified")
+              "certified", "divergence")
 
 
 def _percentiles(xs: List[float]) -> dict:
@@ -76,6 +83,10 @@ class InvariantMonitor:
         self._lite_active = False
         self._lite_stuck_since: Optional[int] = None
         self.lite_valset_sizes: Dict[int, int] = {}
+        # transition-digest cross-check (attach_divergence)
+        self._div_recorders: Dict[int, object] = {}
+        self._div_seen: Dict[int, Dict[int, str]] = {}  # h -> node -> hex
+        self._div_ref: Dict[int, str] = {}              # h -> first digest
 
     # ------------------------------------------------------------ wiring
 
@@ -103,6 +114,15 @@ class InvariantMonitor:
                                         verifier=verifier)
         self._lite_provider = provider
         self._lite_active = True
+
+    def attach_divergence(self, node_id: int, recorder) -> None:
+        """(Re-)register one node's transition-digest recorder
+        (analysis/divergence.DigestRecorder). A crash-restarted node
+        carries a fresh recorder whose stream begins at the replayed
+        height — re-attach overwrites, and replayed heights are
+        re-checked against the net's reference digests."""
+        if recorder is not None:
+            self._div_recorders[node_id] = recorder
 
     # ------------------------------------------------------------ checking
 
@@ -133,6 +153,28 @@ class InvariantMonitor:
                 data = item.data
                 self._on_commit(step, node_id, data["block"])
         self._advance_lite(step)
+        self._check_divergence(step)
+
+    def _check_divergence(self, step: int) -> None:
+        """Fold every recorder's new (height, digest) pairs into the
+        per-height cross-check: the first digest seen for a height is
+        the reference, every other node's digest must match it
+        bit-for-bit."""
+        for node_id, rec in list(self._div_recorders.items()):
+            for height, hexd in rec.stream():
+                seen = self._div_seen.setdefault(height, {})
+                if seen.get(node_id) == hexd:
+                    continue
+                seen[node_id] = hexd
+                ref = self._div_ref.get(height)
+                if ref is None:
+                    self._div_ref[height] = hexd
+                    continue
+                self._check("divergence")
+                if hexd != ref:
+                    self._violate("divergence", step, height=height,
+                                  node=node_id, digest=hexd,
+                                  expected=ref)
 
     def _advance_lite(self, step: int) -> None:
         """Certify every committed height whose (header, commit,
@@ -211,6 +253,7 @@ class InvariantMonitor:
         # saved during the last steps and may not have been readable
         # when their poll ran
         self._advance_lite(final_step)
+        self._check_divergence(final_step)
         # evidence: every injected double-sign must be committed
         for key in sorted(self.expected_double_signs):
             self._check("evidence")
@@ -272,6 +315,12 @@ class InvariantMonitor:
                     [round(x, 4) for x in lat_s]),
             },
             **({"lite": lite} if lite is not None else {}),
+            **({"divergence": {
+                "nodes": len(self._div_recorders),
+                "heights_checked": len(self._div_ref),
+                "mismatches": sum(1 for v in self.violations
+                                  if v["invariant"] == "divergence"),
+            }} if self._div_recorders else {}),
         }
 
     def dump_trace(self, path: str, schedule, report: Optional[dict] = None

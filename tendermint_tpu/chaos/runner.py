@@ -303,6 +303,11 @@ class ChaosNet:
             lambda msg, i=i: self._outbox.append((i, dict(msg)))
             if msg.get("type") in RELAYED else None)
         self.monitor.attach(i, node.event_bus)
+        # TM_TPU_DIVERGENCE=on: the node's BlockExecutor carries a
+        # transition-digest recorder — cross-checked per poll as the
+        # `divergence` invariant (None when the knob is off)
+        self.monitor.attach_divergence(
+            i, getattr(node.consensus.block_exec, "divergence", None))
         return node
 
     def start(self) -> None:

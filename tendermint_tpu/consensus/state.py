@@ -255,6 +255,17 @@ class ConsensusState:
             return causal.span(name, height, round_, **args)
         return causal.null_span()
 
+    def _point_transition_digest(self, height: int, round_: int) -> None:
+        """Stamp the height's transition digest on the causal timeline
+        when the divergence recorder is on — a cross-node trace diff
+        then localizes a fork to its first divergent height."""
+        rec = getattr(self.block_exec, "divergence", None)
+        if rec is not None:
+            digest = rec.digest_at(height)
+            if digest is not None:
+                self._cpoint("transition.digest", height, round_,
+                             digest=digest[:16])
+
     def _publish(self, event: str, extra: Optional[dict] = None) -> None:
         if self.event_bus is not None and not self.replay_mode:
             obj = self.rs.round_state_event_obj()
@@ -896,6 +907,7 @@ class ConsensusState:
                               txs=len(block.data.txs))
         self._cpoint("commit", height, rs.commit_round,
                      txs=len(block.data.txs))
+        self._point_transition_digest(height, rs.commit_round)
 
         self._update_to_state(new_state)
         self._schedule_round0()
@@ -980,6 +992,7 @@ class ConsensusState:
                                      self._overlap_s + self._serial_s)
         self._cpoint("commit", height, rs.commit_round,
                      txs=len(block.data.txs))
+        self._point_transition_digest(height, rs.commit_round)
 
         self._update_to_state(new_state)
         self._kick_precompute()

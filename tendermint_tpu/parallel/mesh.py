@@ -163,6 +163,7 @@ def sharded_verify_kernel(mesh):
     Drop-in `kernel=` for ops.ed25519.verify_batch / BatchVerifier.
     Cached per mesh (compiles are minutes on 1-core CI hosts). A
     1-device mesh degenerates to the plain unsharded jit kernel."""
+    # tmlint: allow(taint): id() is a per-process compile-cache key; the cached kernel's output is mesh-value-determined, bit-equal to host
     key = ("verify", id(mesh))
     if key in _kernel_cache:
         return _kernel_cache[key]
@@ -198,6 +199,7 @@ def sharded_merkle_root(mesh):
     all_gathered and finished identically on every chip. Cached per
     mesh, like sharded_verify_kernel; a 1-device mesh degenerates to
     the plain device root."""
+    # tmlint: allow(taint): id() is a per-process compile-cache key; the cached root kernel is bit-equality-tested against the host path
     key = ("merkle", id(mesh))
     if key in _kernel_cache:
         return _kernel_cache[key]

@@ -141,6 +141,10 @@ class BlockExecutor:
         self.evidence_pool = evidence_pool or MockEvidencePool()
         self.event_bus = event_bus
         self.verifier = verifier
+        # transition-digest stream behind TM_TPU_DIVERGENCE
+        # (analysis/divergence.py); None keeps the hot path untouched
+        from tendermint_tpu.analysis import divergence
+        self.divergence = divergence.maybe_recorder()
 
     def validate_block(self, state: State, block: Block,
                        trust_last_commit: bool = False) -> None:
@@ -200,6 +204,8 @@ class BlockExecutor:
 
             fail.fail_point("execution.after_app_commit")
             new_state.app_hash = app_hash
+            if self.divergence is not None:
+                self.divergence.record(block, responses, new_state)
             if state_store is not None:
                 state_store.save(new_state)
             fail.fail_point("execution.after_save_state")

@@ -74,13 +74,16 @@ from tendermint_tpu.utils import knobs
 #                    (span; outcome + missing-tx count ride as args)
 #   votes.agg        one aggregated vote batch applied through the
 #                    bulk VoteSet path (span; vote count rides as arg)
+#   transition.digest  the height's canonical transition digest
+#                    (analysis/divergence.py) stamped at commit — a
+#                    cross-node trace diff localizes a state fork
 SPAN_CATALOG = frozenset((
     "height.begin", "propose", "proposal.recv", "part.first",
     "block.full", "quorum.prevote", "quorum.precommit",
     "verify.dispatch", "apply", "flush", "wal.fsync", "commit",
     "p2p.recv", "mempool.recv", "stall",
     "snapshot.restore", "sync.chunk", "queue.saturated", "slo.sample",
-    "block.reconstruct", "votes.agg",
+    "block.reconstruct", "votes.agg", "transition.digest",
 ))
 
 DEFAULT_CAPACITY = 65536

@@ -253,6 +253,13 @@ CATALOG: tuple[Knob, ...] = (
          "on wraps threading locks with the lock-order watchdog "
          "(analysis/lockwatch.py); chaos runs report cycles.",
          "analysis/lockwatch.py"),
+    Knob("TM_TPU_DIVERGENCE", "bool", "off", "",
+         "on records a canonical per-height transition digest (block "
+         "bytes, ABCI responses, validator updates, app_hash) for "
+         "cross-node and dual-hash-seed divergence detection "
+         "(analysis/divergence.py); chaos cross-checks it as the "
+         "`divergence` invariant.",
+         "analysis/divergence.py"),
 )
 
 NAMES = frozenset(k.name for k in CATALOG)

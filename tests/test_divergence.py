@@ -1,0 +1,121 @@
+"""Divergence plane tests: the dual-PYTHONHASHSEED differential replay
+(the taint pass's blessed seam for TM_TPU_DIVERGENCE — the seam catalog
+names test_dual_hash_seed_replay_bit_identical, so renaming it without
+updating analysis/checkers/taint.py turns the seam stale and fails
+lint), the canonical transition digest itself, the off-hatch, and the
+chaos monitor's divergence invariant (a perturbed digest must surface
+as a loud violation, never be silently absorbed)."""
+
+import types
+
+from tendermint_tpu.analysis import divergence
+from tendermint_tpu.chaos.monitor import INVARIANTS, InvariantMonitor
+
+
+# ------------------------------------------------- differential replay
+
+
+def test_dual_hash_seed_replay_bit_identical():
+    """The scripted 5-height trajectory produces bit-identical digest
+    streams under two different PYTHONHASHSEED values. Any hash-order
+    dependence in the block/ABCI/app_hash path breaks this."""
+    out = divergence.run_dual_seed_replay()
+    assert out["identical"], (
+        "digest streams diverged across PYTHONHASHSEED "
+        f"{out['hash_seeds']}:\n--- a ---\n{out['streams'][0]}"
+        f"--- b ---\n{out['streams'][1]}")
+    assert out["heights"] == len(divergence._SCRIPT)
+    # streams are "height hexdigest" lines, strictly increasing heights
+    lines = out["streams"][0].splitlines()
+    heights = [int(ln.split()[0]) for ln in lines]
+    assert heights == sorted(heights) == list(
+        range(1, len(divergence._SCRIPT) + 1))
+    for ln in lines:
+        hexd = ln.split()[1]
+        assert len(hexd) == 64 and int(hexd, 16) >= 0
+
+
+def test_in_process_replay_is_seed_deterministic():
+    """Same seed -> identical stream; a different seed moves the pinned
+    clock base, so block times (and therefore digests) change."""
+    a = divergence.replay_digests(seed=7)
+    b = divergence.replay_digests(seed=7)
+    c = divergence.replay_digests(seed=8)
+    assert a == b
+    assert len(a) == len(divergence._SCRIPT)
+    assert a != c
+
+
+def test_recorder_off_hatch(monkeypatch):
+    monkeypatch.delenv("TM_TPU_DIVERGENCE", raising=False)
+    assert not divergence.enabled()
+    assert divergence.maybe_recorder() is None
+    monkeypatch.setenv("TM_TPU_DIVERGENCE", "on")
+    assert divergence.enabled()
+    rec = divergence.maybe_recorder()
+    assert rec is not None and rec.stream() == []
+
+
+def test_cross_check_reports_per_height_mismatch():
+    good = types.SimpleNamespace(
+        stream=lambda: [(1, "aa"), (2, "bb"), (3, "cc")])
+    bad = types.SimpleNamespace(
+        stream=lambda: [(1, "aa"), (2, "XX")])
+    out = divergence.cross_check({"n0": good, "n1": bad})
+    assert out == [{"height": 2, "digests": {"n0": "bb", "n1": "XX"}}]
+
+
+# ------------------------------------------ chaos divergence invariant
+
+
+def _recorder(pairs):
+    return types.SimpleNamespace(stream=lambda: list(pairs))
+
+
+def _sched():
+    return types.SimpleNamespace(episodes=lambda: [])
+
+
+def test_monitor_divergence_invariant_is_loud():
+    """A deliberately perturbed transition digest on one node must show
+    up as a `divergence` violation with the full witness (height, node,
+    both digests) and in the finalize report's mismatch count."""
+    assert "divergence" in INVARIANTS
+    mon = InvariantMonitor()
+    mon.attach_divergence(0, _recorder([(1, "d1"), (2, "d2")]))
+    mon.attach_divergence(1, _recorder([(1, "d1"), (2, "EVIL")]))
+    mon.poll(step=5)
+
+    vio = [v for v in mon.violations if v["invariant"] == "divergence"]
+    assert len(vio) == 1
+    assert vio[0]["height"] == 2 and vio[0]["node"] == 1
+    assert vio[0]["digest"] == "EVIL" and vio[0]["expected"] == "d2"
+    # the matching height was checked too (the oracle can fire)
+    assert mon.checks["divergence"] == 2
+
+    report = mon.finalize(_sched(), final_step=5)
+    assert report["divergence"] == {
+        "nodes": 2, "heights_checked": 2, "mismatches": 1}
+
+
+def test_monitor_divergence_agreeing_nodes_clean():
+    mon = InvariantMonitor()
+    mon.attach_divergence(0, _recorder([(1, "d1")]))
+    mon.attach_divergence(1, _recorder([(1, "d1")]))
+    mon.poll(step=1)
+    # crash-restart: fresh recorder replays height 1 with the same
+    # digest, then extends — re-attach must re-check, not double-count
+    mon.attach_divergence(1, _recorder([(1, "d1"), (2, "d2")]))
+    mon.attach_divergence(0, _recorder([(1, "d1"), (2, "d2")]))
+    mon.poll(step=2)
+    assert not [v for v in mon.violations
+                if v["invariant"] == "divergence"]
+    report = mon.finalize(_sched(), final_step=2)
+    assert report["divergence"]["mismatches"] == 0
+    assert report["divergence"]["heights_checked"] == 2
+
+    # None recorder (knob off) is ignored — no divergence section
+    empty = InvariantMonitor()
+    empty.attach_divergence(0, None)
+    empty.poll(step=1)
+    assert "divergence" not in empty.finalize(_sched(), final_step=1)

@@ -359,12 +359,15 @@ def test_stale_and_unknown_pragmas_are_findings():
 # ------------------------------------------------------------ the tree --
 
 def test_tree_is_clean_with_pragma_budget():
-    """THE gate: the whole scan set at zero findings, <= 10 pragmas,
-    every pragma justified (pragma hygiene runs inside)."""
+    """THE gate: the whole scan set at zero findings, <= 15 pragmas,
+    every pragma justified (pragma hygiene runs inside). The budget
+    went 10 -> 15 with the taint checker (ISSUE 20): five honest
+    suppressions for observe-only fan-out, id()-keyed compile caches
+    and the kvstore test fault hook."""
     findings, pragmas, n_files = run_tree(REPO)
     assert findings == [], "\n".join(str(f) for f in findings)
     assert n_files > 100
-    assert len(pragmas) <= 10
+    assert len(pragmas) <= 15
     assert all(p.justification for p in pragmas)
 
 
@@ -397,6 +400,9 @@ def test_lint_report_is_committed_and_clean():
     assert rep["findings"] == []
     assert rep["files_scanned"] > 100
     assert "metrics" in rep["checkers"]
+    assert "taint" in rep["checkers"]
+    assert rep["taint"]["findings"] == 0
+    assert rep["lint_seconds"] > 0
 
 
 # ---------------------------------------------------------- knobs/clock --
